@@ -1,0 +1,45 @@
+"""dist_keras_tpu — a TPU-native distributed training framework with the
+capability set of dist-keras (Spark + Keras parameter-server training),
+re-designed for JAX/XLA: jitted scan train loops, shard_map data parallelism,
+and the async optimizer family (DOWNPOUR, ADAG, AEASGD, EAMSGD, DynSGD)
+re-expressed as windowed local accumulation + ICI collectives.
+
+See SURVEY.md at the repo root for the reference blueprint this implements.
+"""
+
+__version__ = "0.1.0"
+
+from dist_keras_tpu import data, models, ops, parallel, trainers, utils
+from dist_keras_tpu.data import (
+    AccuracyEvaluator,
+    AUCEvaluator,
+    Dataset,
+    DenseTransformer,
+    LabelIndexTransformer,
+    LossEvaluator,
+    MinMaxTransformer,
+    ModelPredictor,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+)
+from dist_keras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    EAMSGD,
+    AveragingTrainer,
+    DynSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+)
+
+__all__ = [
+    "data", "models", "ops", "parallel", "trainers", "utils",
+    "Dataset", "ModelPredictor",
+    "MinMaxTransformer", "OneHotTransformer", "LabelIndexTransformer",
+    "ReshapeTransformer", "DenseTransformer", "StandardScaleTransformer",
+    "AccuracyEvaluator", "LossEvaluator", "AUCEvaluator",
+    "SingleTrainer", "AveragingTrainer", "EnsembleTrainer",
+    "DOWNPOUR", "ADAG", "AEASGD", "EAMSGD", "DynSGD",
+]

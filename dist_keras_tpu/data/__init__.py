@@ -1,0 +1,26 @@
+from dist_keras_tpu.data.dataset import Dataset
+from dist_keras_tpu.data.evaluators import (
+    AccuracyEvaluator,
+    AUCEvaluator,
+    Evaluator,
+    LossEvaluator,
+)
+from dist_keras_tpu.data.predictors import ModelPredictor, Predictor
+from dist_keras_tpu.data.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+    Transformer,
+)
+
+__all__ = [
+    "Dataset",
+    "Transformer", "MinMaxTransformer", "OneHotTransformer",
+    "LabelIndexTransformer", "ReshapeTransformer", "DenseTransformer",
+    "StandardScaleTransformer",
+    "Predictor", "ModelPredictor",
+    "Evaluator", "AccuracyEvaluator", "LossEvaluator", "AUCEvaluator",
+]

@@ -1,0 +1,81 @@
+"""CSV ingestion.
+
+The reference reads CSVs through Spark (``examples/mnist.py`` loads MNIST
+CSVs into a DataFrame).  Here ingestion happens on the TPU host: a native C++
+parser (``data/native/fastcsv.cpp``, loaded via ctypes) parses numeric CSVs
+multi-threaded straight into a preallocated float32 matrix; pandas is the
+fallback when the extension isn't built or the file isn't purely numeric.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from dist_keras_tpu.data.dataset import Dataset
+
+
+def _native_lib():
+    from dist_keras_tpu.data.native import load_fastcsv
+    return load_fastcsv()
+
+
+def read_numeric_csv(path, has_header=True, dtype=np.float32):
+    """Parse an all-numeric CSV into (matrix, column_names)."""
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            return _read_native(lib, path, has_header, dtype)
+        except Exception:
+            pass  # fall back to pandas below
+    import pandas as pd
+    df = pd.read_csv(path, header=0 if has_header else None)
+    names = [str(c) for c in df.columns]
+    return df.to_numpy(dtype=dtype), names
+
+
+def _read_native(lib, path, has_header, dtype):
+    import ctypes
+
+    with open(path, "rb") as f:
+        header = f.readline() if has_header else b""
+    names = ([c.strip() for c in header.decode().strip().split(",")]
+             if has_header else None)
+
+    rows = ctypes.c_longlong()
+    cols = ctypes.c_longlong()
+    rc = lib.fastcsv_dims(path.encode(), int(has_header),
+                          ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise IOError(f"fastcsv_dims failed rc={rc} on {path}")
+    out = np.empty((rows.value, cols.value), dtype=np.float32)
+    rc = lib.fastcsv_parse(
+        path.encode(), int(has_header),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value, cols.value)
+    if rc != 0:
+        raise IOError(f"fastcsv_parse failed rc={rc} on {path}")
+    if names is None:
+        names = [f"c{i}" for i in range(cols.value)]
+    return out.astype(dtype, copy=False), names
+
+
+def read_csv(path, features=None, label=None, features_col="features",
+             label_col="label", has_header=True):
+    """CSV -> Dataset.
+
+    ``features``: list of column names (default: all but ``label``).
+    ``label``: label column name (default: last column).
+    """
+    mat, names = read_numeric_csv(path, has_header=has_header)
+    if label is None:
+        label = names[-1]
+    if features is None:
+        features = [n for n in names if n != label]
+    fidx = [names.index(c) for c in features]
+    lidx = names.index(label)
+    return Dataset({
+        features_col: mat[:, fidx],
+        label_col: mat[:, lidx],
+    })

@@ -1,0 +1,62 @@
+"""Native extension loader: builds fastcsv.so on first use with g++.
+
+No pybind11 in the image, so the binding is a plain C ABI consumed through
+ctypes (see csv.py).  Build failures degrade gracefully — callers fall back
+to pandas.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastcsv.cpp")
+_SO = os.path.join(_HERE, "fastcsv.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def build_fastcsv(force=False):
+    """Compile fastcsv.cpp -> fastcsv.so. Returns path or None."""
+    if os.path.exists(_SO) and not force and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def load_fastcsv():
+    """Return the ctypes lib (building if needed) or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = build_fastcsv()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.fastcsv_dims.restype = ctypes.c_int
+            lib.fastcsv_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_longlong)]
+            lib.fastcsv_parse.restype = ctypes.c_int
+            lib.fastcsv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_longlong, ctypes.c_longlong]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib

@@ -1,0 +1,154 @@
+// fastcsv — native numeric-CSV parser for the TPU host data path.
+//
+// Role: the reference feeds training data through Spark's CSV reader into
+// DataFrames; our host-side equivalent parses numeric CSVs straight into a
+// preallocated float32 matrix that the Dataset wraps zero-copy.  Parsing is
+// chunk-parallel with std::thread (row boundaries resolved per chunk), and
+// uses strtof directly on a single mmap-style buffer read.
+//
+// C ABI (ctypes):
+//   int fastcsv_dims(const char* path, int has_header,
+//                    long long* rows, long long* cols);
+//   int fastcsv_parse(const char* path, int has_header,
+//                     float* out, long long rows, long long cols);
+// Returns 0 on success, negative error codes otherwise.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Read the whole file into a string (with trailing sentinel newline).
+static int read_file(const char* path, std::string& buf) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  buf.resize(static_cast<size_t>(size));
+  if (size > 0 && std::fread(&buf[0], 1, static_cast<size_t>(size), f) !=
+                      static_cast<size_t>(size)) {
+    std::fclose(f);
+    return -2;
+  }
+  std::fclose(f);
+  if (buf.empty() || buf.back() != '\n') buf.push_back('\n');
+  return 0;
+}
+
+// Skip the header line, returning the offset of the first data byte.
+static size_t data_start(const std::string& buf, int has_header) {
+  if (!has_header) return 0;
+  size_t p = buf.find('\n');
+  return p == std::string::npos ? buf.size() : p + 1;
+}
+
+static void parse_chunk(const char* base, size_t begin, size_t end,
+                        float* out, long long cols, long long row0) {
+  const char* p = base + begin;
+  const char* stop = base + end;
+  long long row = row0;
+  while (p < stop) {
+    float* dst = out + row * cols;
+    for (long long c = 0; c < cols; ++c) {
+      char* next = nullptr;
+      dst[c] = std::strtof(p, &next);
+      p = (next && next != p) ? next : p + 1;
+      while (p < stop && (*p == ',' || *p == ' ' || *p == '\r')) ++p;
+    }
+    while (p < stop && *p != '\n') ++p;  // tolerate ragged tails
+    if (p < stop) ++p;                   // consume newline
+    ++row;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int fastcsv_dims(const char* path, int has_header, long long* rows,
+                 long long* cols) {
+  std::string buf;
+  int rc = read_file(path, buf);
+  if (rc != 0) return rc;
+  size_t start = data_start(buf, has_header);
+  long long nrows = 0, ncols = 0;
+  // Column count from the first data line.
+  size_t eol = buf.find('\n', start);
+  if (eol == std::string::npos) {
+    *rows = 0;
+    *cols = 0;
+    return 0;
+  }
+  ncols = 1;
+  for (size_t i = start; i < eol; ++i)
+    if (buf[i] == ',') ++ncols;
+  for (size_t i = start; i < buf.size(); ++i) {
+    if (buf[i] == '\n') {
+      // Count only non-empty lines.
+      if (i > start && buf[i - 1] != '\n') ++nrows;
+      else if (i == start) { /* empty first line */ }
+    }
+  }
+  *rows = nrows;
+  *cols = ncols;
+  return 0;
+}
+
+int fastcsv_parse(const char* path, int has_header, float* out,
+                  long long rows, long long cols) {
+  std::string buf;
+  int rc = read_file(path, buf);
+  if (rc != 0) return rc;
+  size_t start = data_start(buf, has_header);
+  if (rows == 0) return 0;
+
+  unsigned n_threads = std::thread::hardware_concurrency();
+  if (n_threads == 0) n_threads = 1;
+  if (static_cast<long long>(n_threads) > rows)
+    n_threads = static_cast<unsigned>(rows);
+
+  // Split [start, size) into n_threads chunks on row boundaries, tracking
+  // the starting row index of each chunk so outputs land in place.
+  std::vector<size_t> chunk_begin;
+  std::vector<long long> chunk_row;
+  size_t size = buf.size();
+  chunk_begin.push_back(start);
+  chunk_row.push_back(0);
+  if (n_threads > 1) {
+    size_t approx = (size - start) / n_threads;
+    long long row_cursor = 0;
+    size_t pos = start;
+    for (unsigned t = 1; t < n_threads; ++t) {
+      size_t target = start + approx * t;
+      if (target <= pos) continue;
+      // Count rows from pos to the newline at/after target.
+      while (pos < size && pos < target) {
+        if (buf[pos] == '\n') ++row_cursor;
+        ++pos;
+      }
+      while (pos < size && buf[pos - 1] != '\n') {
+        if (buf[pos] == '\n') ++row_cursor;
+        ++pos;
+      }
+      if (pos >= size) break;
+      chunk_begin.push_back(pos);
+      chunk_row.push_back(row_cursor);
+    }
+  }
+  chunk_begin.push_back(size);
+
+  std::vector<std::thread> threads;
+  for (size_t t = 0; t + 1 < chunk_begin.size(); ++t) {
+    threads.emplace_back(parse_chunk, buf.data(), chunk_begin[t],
+                         chunk_begin[t + 1], out, cols, chunk_row[t]);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,379 @@
+"""Functional layer library (Keras-flavoured surface, JAX-native core).
+
+The reference builds models with Keras ``Sequential`` + ``Dense``/``Conv2D``
+etc. and ships them to workers as (architecture JSON, weight list)
+(``distkeras/utils.py:~40``).  We reproduce that *surface* — layers with the
+familiar constructor args, JSON round-trip, Keras-ordered weight lists — on a
+functional core: every layer is stateless, with
+
+    params, out_shape = layer.init(key, in_shape)
+    y = layer.apply(params, x, training=..., rng=...)
+
+so a whole model is a pure function of a params pytree: exactly what
+``jax.jit`` / ``shard_map`` / ``jax.grad`` want.
+
+TPU notes:
+- Default parameter dtype is float32; compute casting to bf16 is applied by
+  trainers via a policy, keeping the MXU fed with bf16 matmuls while the
+  optimizer state stays f32.
+- ``Conv2D`` uses NHWC, the layout XLA:TPU prefers.
+- No Python control flow depends on data; dropout uses ``jax.random`` with an
+  explicit rng.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import nn as jnn
+
+# --------------------------------------------------------------------------
+# activations
+# --------------------------------------------------------------------------
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": jnn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": jnn.sigmoid,
+    "softmax": lambda x: jnn.softmax(x, axis=-1),
+    "gelu": jnn.gelu,
+    "elu": jnn.elu,
+    "softplus": jnn.softplus,
+    "leaky_relu": jnn.leaky_relu,
+    "silu": jnn.silu,
+}
+
+
+def get_activation(name):
+    if name is None:
+        return _ACTIVATIONS["linear"]
+    if callable(name):
+        return name
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"Unknown activation {name!r}") from None
+
+
+# --------------------------------------------------------------------------
+# initializers (Keras defaults)
+# --------------------------------------------------------------------------
+
+def glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: (kh, kw, in, out)
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+# --------------------------------------------------------------------------
+# layer base + registry
+# --------------------------------------------------------------------------
+
+LAYER_REGISTRY = {}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Layer:
+    """Stateless layer: config in the object, parameters in a pytree."""
+
+    def init(self, key, in_shape):
+        """-> (params, out_shape). in/out shapes exclude the batch dim."""
+        return {}, in_shape
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return x
+
+    # ---- config round-trip (Keras `get_config` / `from_config` parity) ----
+    def get_config(self):
+        return {}
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(**config)
+
+    # ---- weight ordering (Keras: kernel then bias, layer by layer) ----
+    def weight_names(self):
+        """Ordered parameter names for get_weights/set_weights."""
+        return []
+
+    def __repr__(self):
+        cfg = ", ".join(f"{k}={v!r}" for k, v in self.get_config().items())
+        return f"{type(self).__name__}({cfg})"
+
+
+@register_layer
+class Dense(Layer):
+    def __init__(self, units, activation=None, use_bias=True):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+
+    def init(self, key, in_shape):
+        in_dim = in_shape[-1]
+        params = {"kernel": glorot_uniform(key, (in_dim, self.units))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), jnp.float32)
+        return params, (*in_shape[:-1], self.units)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        y = x @ params["kernel"]
+        if self.use_bias:
+            y = y + params["bias"]
+        return get_activation(self.activation)(y)
+
+    def get_config(self):
+        return {"units": self.units, "activation": self.activation,
+                "use_bias": self.use_bias}
+
+    def weight_names(self):
+        return ["kernel", "bias"] if self.use_bias else ["kernel"]
+
+
+@register_layer
+class Conv2D(Layer):
+    """NHWC conv. Kernel layout HWIO (XLA:TPU native)."""
+
+    def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
+                 activation=None, use_bias=True):
+        self.filters = int(filters)
+        self.kernel_size = tuple(np.broadcast_to(kernel_size, (2,)).tolist())
+        self.strides = tuple(np.broadcast_to(strides, (2,)).tolist())
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        kh, kw = self.kernel_size
+        params = {"kernel": glorot_uniform(key, (kh, kw, c, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), jnp.float32)
+        out = jax.eval_shape(
+            lambda k: self._conv(jnp.zeros((1, h, w, c)), k),
+            jax.ShapeDtypeStruct((kh, kw, c, self.filters), jnp.float32),
+        )
+        return params, tuple(out.shape[1:])
+
+    def _conv(self, x, kernel):
+        return lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides,
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def apply(self, params, x, *, training=False, rng=None):
+        y = self._conv(x, params["kernel"].astype(x.dtype))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return get_activation(self.activation)(y)
+
+    def get_config(self):
+        return {"filters": self.filters, "kernel_size": self.kernel_size,
+                "strides": self.strides, "padding": self.padding,
+                "activation": self.activation, "use_bias": self.use_bias}
+
+    def weight_names(self):
+        return ["kernel", "bias"] if self.use_bias else ["kernel"]
+
+
+class _Pool2D(Layer):
+    _reducer = None
+    _init_val = None
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid"):
+        self.pool_size = tuple(np.broadcast_to(pool_size, (2,)).tolist())
+        self.strides = (tuple(np.broadcast_to(strides, (2,)).tolist())
+                        if strides is not None else self.pool_size)
+        self.padding = padding
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        out = jax.eval_shape(
+            lambda: self.apply({}, jnp.zeros((1, h, w, c))))
+        return {}, tuple(out.shape[1:])
+
+    def _pool(self, x):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        return lax.reduce_window(
+            x, self._init_val, self._reducer,
+            window_dimensions=(1, ph, pw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=self.padding.upper(),
+        )
+
+    def get_config(self):
+        return {"pool_size": self.pool_size, "strides": self.strides,
+                "padding": self.padding}
+
+
+@register_layer
+class MaxPool2D(_Pool2D):
+    def apply(self, params, x, *, training=False, rng=None):
+        self._reducer = lax.max
+        self._init_val = -jnp.inf
+        return self._pool(x)
+
+
+@register_layer
+class AvgPool2D(_Pool2D):
+    def apply(self, params, x, *, training=False, rng=None):
+        self._reducer = lax.add
+        self._init_val = 0.0
+        ph, pw = self.pool_size
+        return self._pool(x) / (ph * pw)
+
+
+@register_layer
+class Flatten(Layer):
+    def init(self, key, in_shape):
+        return {}, (int(np.prod(in_shape)),)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return x.reshape(x.shape[0], -1)
+
+
+@register_layer
+class Reshape(Layer):
+    def __init__(self, target_shape):
+        self.target_shape = tuple(target_shape)
+
+    def init(self, key, in_shape):
+        return {}, self.target_shape
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return x.reshape(x.shape[0], *self.target_shape)
+
+    def get_config(self):
+        return {"target_shape": self.target_shape}
+
+
+@register_layer
+class Activation(Layer):
+    def __init__(self, activation):
+        self.activation = activation
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return get_activation(self.activation)(x)
+
+    def get_config(self):
+        return {"activation": self.activation}
+
+
+@register_layer
+class Dropout(Layer):
+    def __init__(self, rate):
+        self.rate = float(rate)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError("Dropout needs an rng when training=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def get_config(self):
+        return {"rate": self.rate}
+
+
+@register_layer
+class LayerNorm(Layer):
+    def __init__(self, epsilon=1e-5):
+        self.epsilon = float(epsilon)
+
+    def init(self, key, in_shape):
+        dim = in_shape[-1]
+        return {"scale": jnp.ones((dim,), jnp.float32),
+                "bias": jnp.zeros((dim,), jnp.float32)}, in_shape
+
+    def apply(self, params, x, *, training=False, rng=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * lax.rsqrt(var + self.epsilon)
+        return y * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+    def get_config(self):
+        return {"epsilon": self.epsilon}
+
+    def weight_names(self):
+        return ["scale", "bias"]
+
+
+@register_layer
+class BatchNorm(Layer):
+    """Batch normalisation.
+
+    Functional twist: running statistics are *parameters* (leaves named
+    ``moving_mean``/``moving_var``) updated by the trainer via the aux-state
+    channel, not hidden layer state.  In training mode the layer normalises
+    with batch statistics; in inference mode with the stored moving stats.
+    """
+
+    def __init__(self, momentum=0.99, epsilon=1e-3):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+
+    def init(self, key, in_shape):
+        dim = in_shape[-1]
+        return {
+            "gamma": jnp.ones((dim,), jnp.float32),
+            "beta": jnp.zeros((dim,), jnp.float32),
+            "moving_mean": jnp.zeros((dim,), jnp.float32),
+            "moving_var": jnp.ones((dim,), jnp.float32),
+        }, in_shape
+
+    def apply(self, params, x, *, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mu = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mu, var = params["moving_mean"], params["moving_var"]
+        y = (x - mu.astype(x.dtype)) * lax.rsqrt(var.astype(x.dtype) + self.epsilon)
+        return y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+
+    def get_config(self):
+        return {"momentum": self.momentum, "epsilon": self.epsilon}
+
+    def weight_names(self):
+        return ["gamma", "beta", "moving_mean", "moving_var"]
+
+
+@register_layer
+class Embedding(Layer):
+    def __init__(self, input_dim, output_dim):
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+
+    def init(self, key, in_shape):
+        table = jax.random.normal(
+            key, (self.input_dim, self.output_dim)) * 0.02
+        return {"embeddings": table}, (*in_shape, self.output_dim)
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return jnp.take(params["embeddings"], x.astype(jnp.int32), axis=0)
+
+    def get_config(self):
+        return {"input_dim": self.input_dim, "output_dim": self.output_dim}
+
+    def weight_names(self):
+        return ["embeddings"]

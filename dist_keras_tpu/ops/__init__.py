@@ -1,0 +1,4 @@
+from dist_keras_tpu.ops.losses import get_loss, register_loss
+from dist_keras_tpu.ops.optimizers import get_optimizer, register_optimizer
+
+__all__ = ["get_loss", "register_loss", "get_optimizer", "register_optimizer"]

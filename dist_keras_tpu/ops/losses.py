@@ -1,0 +1,74 @@
+"""Loss registry with Keras-string parity.
+
+The reference passes Keras loss strings straight through to ``model.compile``
+inside each worker (``distkeras/workers.py:~45`` ``prepare_model``).  We keep
+the same strings as the public contract and map them to jit-friendly pure
+functions ``loss(logits_or_preds, targets) -> scalar``.
+
+TPU note: every loss here is written against *logits* where a stable fused
+form exists (log-softmax / log-sigmoid), so models in ``models/zoo.py`` emit
+logits and XLA fuses the softmax into the loss — cheaper on the VPU and
+numerically safe in bf16.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import nn as jnn
+
+
+def categorical_crossentropy(logits, targets):
+    """One-hot targets vs logits. Matches Keras `categorical_crossentropy`
+    semantics (mean over batch) with from_logits=True stability."""
+    logp = jnn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(targets * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(logits, targets):
+    """Integer targets vs logits."""
+    logp = jnn.log_softmax(logits, axis=-1)
+    tgt = targets.astype(jnp.int32).reshape(logits.shape[:-1])
+    picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def binary_crossentropy(logits, targets):
+    """Binary targets (0/1, any float shape) vs logits."""
+    logits = logits.reshape(targets.shape)
+    # log sigmoid(x) = -softplus(-x);  log(1-sigmoid(x)) = -softplus(x)
+    loss = jnn.softplus(logits) - targets * logits
+    return jnp.mean(loss)
+
+
+def mean_squared_error(preds, targets):
+    return jnp.mean(jnp.square(preds - targets))
+
+
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+_LOSSES = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mean_squared_error": mean_squared_error,
+    "mse": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mae": mean_absolute_error,
+}
+
+
+def get_loss(loss):
+    """Resolve a Keras-style loss string or pass a callable through."""
+    if callable(loss):
+        return loss
+    try:
+        return _LOSSES[loss]
+    except KeyError:
+        raise ValueError(
+            f"Unknown loss {loss!r}; known: {sorted(_LOSSES)}") from None
+
+
+def register_loss(name, fn):
+    _LOSSES[name] = fn

@@ -1,0 +1,80 @@
+"""Optimizer registry: Keras optimizer strings -> optax transforms.
+
+The reference forwards ``worker_optimizer`` strings (e.g. ``'adagrad'``,
+``'adam'``) to Keras ``model.compile`` inside each worker
+(``distkeras/workers.py:~45``).  We map the same strings onto optax with
+hyperparameter defaults matching Keras (eps=1e-7 where Keras uses 1e-7),
+so ``ADAG(model, worker_optimizer='adagrad', ...)`` behaves like the
+reference call.
+
+Each entry is a factory ``f(**overrides) -> optax.GradientTransformation``.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
+    return optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov)
+
+
+def _adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-7):
+    return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+
+def _adagrad(learning_rate=1e-3, initial_accumulator_value=0.1, eps=1e-7):
+    return optax.adagrad(
+        learning_rate,
+        initial_accumulator_value=initial_accumulator_value,
+        eps=eps,
+    )
+
+
+def _rmsprop(learning_rate=1e-3, rho=0.9, eps=1e-7, momentum=0.0):
+    return optax.rmsprop(
+        learning_rate, decay=rho, eps=eps, momentum=momentum or None)
+
+
+def _adadelta(learning_rate=1e-3, rho=0.95, eps=1e-7):
+    return optax.adadelta(learning_rate, rho=rho, eps=eps)
+
+
+def _nadam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-7):
+    return optax.nadam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+
+def _adamw(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-7, weight_decay=4e-3):
+    return optax.adamw(
+        learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+
+
+_OPTIMIZERS = {
+    "sgd": _sgd,
+    "adam": _adam,
+    "adagrad": _adagrad,
+    "rmsprop": _rmsprop,
+    "adadelta": _adadelta,
+    "nadam": _nadam,
+    "adamw": _adamw,
+}
+
+
+def get_optimizer(optimizer, **overrides):
+    """Resolve a Keras-style optimizer string (with optional hyperparameter
+    overrides) or pass an optax GradientTransformation through."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if callable(optimizer) and not isinstance(optimizer, str):
+        return optimizer(**overrides)
+    try:
+        factory = _OPTIMIZERS[optimizer]
+    except KeyError:
+        raise ValueError(
+            f"Unknown optimizer {optimizer!r}; known: {sorted(_OPTIMIZERS)}"
+        ) from None
+    return factory(**overrides)
+
+
+def register_optimizer(name, factory):
+    _OPTIMIZERS[name] = factory

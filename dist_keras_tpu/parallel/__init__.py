@@ -1,0 +1,18 @@
+from dist_keras_tpu.parallel.collectives import (
+    tree_all_gather,
+    tree_pmean,
+    tree_ppermute,
+    tree_psum,
+)
+from dist_keras_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    SEQ_AXIS,
+    WORKER_AXIS,
+    grid_mesh,
+    worker_mesh,
+)
+
+__all__ = [
+    "worker_mesh", "grid_mesh", "WORKER_AXIS", "MODEL_AXIS", "SEQ_AXIS",
+    "tree_psum", "tree_pmean", "tree_all_gather", "tree_ppermute",
+]

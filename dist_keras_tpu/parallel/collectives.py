@@ -1,0 +1,66 @@
+"""Collective helpers over pytrees.
+
+This file is the TPU-native replacement for the reference's entire wire layer
+(``distkeras/networking.py`` — length-prefixed pickle over TCP) and the
+parameter-server commit/pull protocol (``distkeras/parameter_servers.py``):
+weight exchange compiles into XLA collectives riding ICI instead of a
+hub-and-spoke socket server on the driver.
+
+All helpers are meant to be called *inside* ``shard_map``-decorated functions
+where the named axis is bound.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+
+
+def tree_psum(tree, axis=WORKER_AXIS):
+    """Sum a pytree across the axis — the 'everybody commits a delta'
+    aggregate (parameter_servers.py:~240 handle_commit, all workers at
+    once)."""
+    return jax.tree.map(lambda x: lax.psum(x, axis), tree)
+
+
+def tree_pmean(tree, axis=WORKER_AXIS):
+    """Average a pytree across the axis — AveragingTrainer's merge
+    (trainers.py:~190) as one fused collective."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
+
+
+def tree_all_gather(tree, axis=WORKER_AXIS):
+    return jax.tree.map(lambda x: lax.all_gather(x, axis), tree)
+
+
+def tree_ppermute(tree, perm, axis=WORKER_AXIS):
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def tree_pvary(tree, axis=WORKER_AXIS):
+    """Mark a replicated pytree as device-varying along ``axis``.
+
+    CRITICAL for per-worker local state inside shard_map: differentiating a
+    worker-varying loss w.r.t. *replicated* params transposes the implicit
+    replicated->varying promotion into a hidden ``psum`` — every "local"
+    gradient step silently becomes a summed-all-workers step and the params
+    stay replicated.  Casting the local copy to varying first keeps worker
+    updates genuinely local; only explicit collectives then cross workers.
+    """
+    def _pvary(x):
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        if axis in vma:  # already varying: pcast would reject
+            return x
+        return lax.pcast(x, (axis,), to="varying")
+
+    return jax.tree.map(_pvary, tree)
+
+
+def axis_index(axis=WORKER_AXIS):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis=WORKER_AXIS):
+    return lax.axis_size(axis)

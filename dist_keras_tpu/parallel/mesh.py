@@ -1,0 +1,69 @@
+"""Device mesh construction.
+
+The reference's "cluster" is Spark executors + a driver parameter server; the
+TPU equivalent is a ``jax.sharding.Mesh`` over ICI (and DCN across hosts).
+Axis-name conventions used throughout the framework:
+
+- ``workers`` — data-parallel axis; one "worker" in the dist-keras sense
+  (a full model replica running the hot loop, workers.py:~30) maps to one
+  mesh slot along this axis.
+- ``model``  — tensor-parallel axis (new capability; absent upstream).
+- ``seq``    — sequence/context-parallel axis (ring attention).
+
+Helpers here never require real multi-chip hardware: on CPU with
+``--xla_force_host_platform_device_count=N`` the same code paths run on N
+virtual devices (the analogue of the reference's ``local[N]`` Spark master,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def worker_mesh(num_workers=None, devices=None):
+    """1-D data-parallel mesh over ``num_workers`` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_workers is None:
+        num_workers = len(devices)
+    if num_workers > len(devices):
+        raise ValueError(
+            f"num_workers={num_workers} > available devices {len(devices)}; "
+            "on CPU set XLA_FLAGS=--xla_force_host_platform_device_count")
+    return Mesh(np.array(devices[:num_workers]), (WORKER_AXIS,))
+
+
+def grid_mesh(axis_sizes: dict, devices=None):
+    """N-D mesh, e.g. {'workers': 2, 'model': 2, 'seq': 2} -> 8 devices.
+
+    Axis order follows dict order; ICI-heavy axes (model/seq) should come
+    last so neighbouring devices share the fastest links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = tuple(int(s) for s in axis_sizes.values())
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(sizes)
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def replicated(mesh):
+    """Sharding that replicates a pytree across the whole mesh."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh, axis=WORKER_AXIS, ndim=1):
+    """Sharding that splits the leading dim over ``axis``."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def num_available_devices():
+    return len(jax.devices())

@@ -1,0 +1,17 @@
+from dist_keras_tpu.trainers.averaging import AveragingTrainer, EnsembleTrainer
+from dist_keras_tpu.trainers.base import DistributedTrainer, Trainer
+from dist_keras_tpu.trainers.dynsgd import DynSGD
+from dist_keras_tpu.trainers.single import SingleTrainer
+from dist_keras_tpu.trainers.windowed import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    EAMSGD,
+    AsynchronousDistributedTrainer,
+)
+
+__all__ = [
+    "Trainer", "DistributedTrainer", "AsynchronousDistributedTrainer",
+    "SingleTrainer", "AveragingTrainer", "EnsembleTrainer",
+    "DOWNPOUR", "ADAG", "AEASGD", "EAMSGD", "DynSGD",
+]

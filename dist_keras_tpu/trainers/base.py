@@ -1,0 +1,107 @@
+"""Trainer base classes — parity with ``distkeras/trainers.py``.
+
+``Trainer`` (trainers.py:~35) holds the serialized model + loss + worker
+optimizer, records wall-clock training time (``record_training_start/stop``,
+trainers.py:~60) and exposes ``get_history()`` / ``get_training_time()``.
+
+``DistributedTrainer`` (trainers.py:~290) adds ``num_workers`` and the mesh
+(the TPU stand-in for the Spark executor pool + parameter-server service:
+``start_service``/``stop_service`` became "construct a Mesh").  The
+``master_port``/``master_host`` kwargs of the reference are accepted and
+ignored — there is no socket server to bind; the exchange compiles into ICI
+collectives (see parallel/collectives.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from dist_keras_tpu.ops.losses import get_loss
+from dist_keras_tpu.ops.optimizers import get_optimizer
+from dist_keras_tpu.parallel.mesh import worker_mesh
+from dist_keras_tpu.utils.serialization import deserialize_model, serialize_model
+
+
+class Trainer:
+    def __init__(self, keras_model, loss="categorical_crossentropy",
+                 worker_optimizer="adam", optimizer_kwargs=None,
+                 features_col="features", label_col="label",
+                 batch_size=32, num_epoch=1, seed=0, compute_dtype=None):
+        self.serialized_model = serialize_model(keras_model)
+        self.loss = loss
+        self.worker_optimizer = worker_optimizer
+        self.optimizer_kwargs = dict(optimizer_kwargs or {})
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = int(seed)
+        self.compute_dtype = compute_dtype
+        self.history = []
+        self._t_start = None
+        self._t_stop = None
+
+    # ---- timing (trainers.py:~60) ----
+    def record_training_start(self):
+        self._t_start = time.time()
+
+    def record_training_end(self):
+        self._t_stop = time.time()
+
+    def get_training_time(self):
+        if self._t_start is None or self._t_stop is None:
+            return 0.0
+        return self._t_stop - self._t_start
+
+    def get_history(self):
+        return self.history
+
+    def get_averaged_history(self):
+        return float(np.mean(np.asarray(self.history))) if len(
+            np.ravel(self.history)) else float("nan")
+
+    # ---- shared plumbing ----
+    def _fresh_model(self):
+        return deserialize_model(self.serialized_model)
+
+    def _resolve(self):
+        """-> (model, loss_fn, optimizer transform)."""
+        model = self._fresh_model()
+        return (model, get_loss(self.loss),
+                get_optimizer(self.worker_optimizer, **self.optimizer_kwargs))
+
+    def _finalize(self, params, history):
+        """Install trained params into a fresh model; record history."""
+        self.history = history
+        model = self._fresh_model()
+        model.set_params(jax.tree.map(np.asarray, params))
+        return model
+
+    def train(self, dataset, shuffle=False):
+        raise NotImplementedError
+
+
+class DistributedTrainer(Trainer):
+    """Base for every multi-worker trainer (trainers.py:~290)."""
+
+    def __init__(self, keras_model, num_workers=2, master_host=None,
+                 master_port=5000, mesh=None, **kw):
+        super().__init__(keras_model, **kw)
+        self.num_workers = int(num_workers)
+        # master_host/master_port: reference PS kwargs, accepted for parity.
+        del master_host, master_port
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = worker_mesh(self.num_workers)
+        return self._mesh
+
+    def _shards(self, dataset):
+        return dataset.worker_shards(
+            self.num_workers, self.batch_size,
+            features_col=self.features_col, label_col=self.label_col)

@@ -1,0 +1,117 @@
+"""DynSGD — staleness-scaled updates, with *real* staleness under SPMD.
+
+Reference semantics (workers.py:~530 + parameter_servers.py:~280): each
+worker commits ``{delta, last_seen_update}`` and the PS scales the commit by
+``1/(staleness+1)`` where ``staleness = num_updates - last_seen_update``.
+
+Staleness is meaningless if all workers commit in lockstep, so a plain
+windowed port would degenerate to DOWNPOUR (SURVEY.md §7 hard part #1).
+Instead we *stagger* the commit schedule: worker ``i`` commits every
+``communication_window`` steps at phase offset ``i*W/N``.  Commits from
+different workers then land at different global steps, the center variable
+moves between a worker's pull and its next commit, and the DynSGD staleness
+counter measures exactly what it does in the reference — how many center
+updates the worker missed.  The commit itself is a masked ``psum`` executed
+every step (zero contribution from non-committing workers), so the whole
+schedule stays one compiled ``lax.scan`` with no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
+from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+from dist_keras_tpu.trainers.base import DistributedTrainer
+from dist_keras_tpu.trainers.step import make_sgd_step
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+
+class DynSGD(DistributedTrainer):
+    def __init__(self, keras_model, num_workers=2, communication_window=5,
+                 **kw):
+        super().__init__(keras_model, num_workers=num_workers, **kw)
+        self.communication_window = int(communication_window)
+
+    def train(self, dataset, shuffle=False):
+        model, loss_fn, tx = self._resolve()
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
+        xs = np.tile(xs, (1, self.num_epoch) + (1,) * (xs.ndim - 2))
+        ys = np.tile(ys, (1, self.num_epoch) + (1,) * (ys.ndim - 2))
+
+        W = self.communication_window
+        N = self.num_workers
+        mesh = self.mesh
+        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
+
+        def body(params, xs, ys, rng):
+            xs, ys = xs[0], ys[0]
+            widx = jax.lax.axis_index(WORKER_AXIS)
+            rng = jax.random.fold_in(rng, widx)
+            phase = (widx * W) // N  # stagger commits across the window
+
+            center = params
+            # pulled/local/opt_state/last_seen diverge per worker inside the
+            # scan; mark them device-varying up front (see tree_pvary — also
+            # required so local gradients stay local).
+            pulled = tree_pvary(params)
+            local = tree_pvary(params)
+            opt_state = tree_pvary(tx.init(params))
+            last_seen = tree_pvary(jnp.zeros((), jnp.int32))
+            global_count = jnp.zeros((), jnp.int32)
+
+            def one_step(carry, inp):
+                (center, pulled, local, opt_state, rng,
+                 last_seen, global_count) = carry
+                t, x, y = inp
+                (local, opt_state, rng), loss = step(
+                    (local, opt_state, rng), (x, y))
+
+                commit = ((t + 1 + phase) % W == 0)
+                m = commit.astype(jnp.float32)
+                staleness = (global_count - last_seen).astype(jnp.float32)
+                scale = m / (staleness + 1.0)
+                contribution = jax.tree.map(
+                    lambda l, p: scale * (l - p), local, pulled)
+                center = jax.tree.map(
+                    lambda c, d: c + d, center, tree_psum(contribution))
+                global_count = global_count + jax.lax.psum(
+                    commit.astype(jnp.int32), WORKER_AXIS)
+                # committing workers pull the fresh center
+                local = jax.tree.map(
+                    lambda l, c: jnp.where(commit, c, l), local, center)
+                pulled = jax.tree.map(
+                    lambda p, c: jnp.where(commit, c, p), pulled, center)
+                last_seen = jnp.where(commit, global_count, last_seen)
+                return (center, pulled, local, opt_state, rng,
+                        last_seen, global_count), loss
+
+            steps = xs.shape[0]
+            ts = jnp.arange(steps)
+            carry = (center, pulled, local, opt_state, rng,
+                     last_seen, global_count)
+            carry, losses = jax.lax.scan(one_step, carry, (ts, xs, ys))
+            center = carry[0]
+            return center, losses[None]
+
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+            out_specs=(P(), P(WORKER_AXIS)),
+        ))
+
+        self.record_training_start()
+        params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
+                            jax.random.PRNGKey(self.seed))
+        jax.block_until_ready(params)
+        self.record_training_end()
+        return self._finalize(params, np.asarray(losses).tolist())

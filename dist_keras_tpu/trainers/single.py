@@ -1,0 +1,48 @@
+"""SingleTrainer — parity with ``distkeras/trainers.py:~100``.
+
+Reference path: coalesce the DataFrame to one partition and run a plain
+epochs x train_on_batch loop in one Spark task (SURVEY.md §3.1).  TPU-native:
+the whole epoch is ONE jitted ``lax.scan`` over pre-batched device arrays;
+the Python epoch loop re-enters the same compiled computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_keras_tpu.trainers.base import Trainer
+from dist_keras_tpu.trainers.step import make_sgd_step, scan_epoch
+
+
+class SingleTrainer(Trainer):
+    def train(self, dataset, shuffle=False):
+        model, loss_fn, tx = self._resolve()
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        xb, yb = dataset.batches(
+            self.batch_size, self.features_col, self.label_col)
+
+        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
+        params = model.params
+        opt_state = tx.init(params)
+        rng = jax.random.PRNGKey(self.seed)
+
+        @jax.jit
+        def run_epoch(params, opt_state, rng, xb, yb):
+            return scan_epoch(step, params, opt_state, rng, xb, yb)
+
+        xb = jnp.asarray(xb)
+        yb = jnp.asarray(yb)
+
+        self.record_training_start()
+        losses = []
+        for _ in range(self.num_epoch):
+            params, opt_state, rng, ls = run_epoch(
+                params, opt_state, rng, xb, yb)
+            losses.append(np.asarray(ls))
+        jax.block_until_ready(params)
+        self.record_training_end()
+
+        return self._finalize(params, np.concatenate(losses).tolist())

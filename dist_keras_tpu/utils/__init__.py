@@ -1,0 +1,44 @@
+"""Utility layer: pytree algebra, serialization, misc helpers.
+
+Parity surface of the reference's ``distkeras/utils.py`` plus TPU-native
+pytree helpers used throughout the framework.
+"""
+
+from dist_keras_tpu.utils.misc import (
+    history_average_loss,
+    new_dataframe_row,
+    precache,
+    shuffle,
+    to_vector,
+)
+from dist_keras_tpu.utils.pytree import (
+    tree_add,
+    tree_axpy,
+    tree_cast,
+    tree_global_norm,
+    tree_mean,
+    tree_scale,
+    tree_size,
+    tree_sub,
+    tree_zeros_like,
+)
+from dist_keras_tpu.utils.serialization import (
+    deserialize_keras_model,
+    deserialize_model,
+    pickle_object,
+    serialize_keras_model,
+    serialize_model,
+    to_host,
+    unpickle_object,
+    uniform_weights,
+)
+
+__all__ = [
+    "tree_add", "tree_sub", "tree_scale", "tree_axpy", "tree_zeros_like",
+    "tree_mean", "tree_global_norm", "tree_cast", "tree_size",
+    "serialize_model", "deserialize_model", "serialize_keras_model",
+    "deserialize_keras_model", "pickle_object", "unpickle_object",
+    "uniform_weights", "to_host",
+    "to_vector", "shuffle", "precache", "new_dataframe_row",
+    "history_average_loss",
+]

@@ -1,0 +1,67 @@
+"""Pytree arithmetic helpers.
+
+The reference (dist-keras) manipulates lists of numpy weight arrays by hand
+(e.g. accumulating deltas in ``distkeras/workers.py:~230-600`` and averaging
+them in ``distkeras/trainers.py:~190``).  On TPU the natural unit is a JAX
+pytree; these helpers give the same algebra over arbitrary pytrees and are
+used by every trainer strategy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_add(a, b):
+    """a + b, leafwise."""
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a, b):
+    """a - b, leafwise."""
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a, s):
+    """a * s for a scalar s, leafwise."""
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_axpy(alpha, x, y):
+    """alpha * x + y, leafwise (BLAS axpy over pytrees)."""
+    return jax.tree.map(lambda xi, yi: alpha * xi + yi, x, y)
+
+
+def tree_zeros_like(a):
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_mean(trees):
+    """Mean of a list of identically-structured pytrees (host-side merge,
+    mirrors the driver-side numpy mean in ``trainers.py:~190``)."""
+    n = len(trees)
+    acc = trees[0]
+    for t in trees[1:]:
+        acc = tree_add(acc, t)
+    return tree_scale(acc, 1.0 / n)
+
+
+def tree_global_norm(a):
+    """L2 norm over all leaves."""
+    leaves = jax.tree.leaves(a)
+    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
+
+
+def tree_cast(a, dtype):
+    """Cast floating leaves to ``dtype`` (used for bf16 compute policies)."""
+    def _cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(_cast, a)
+
+
+def tree_size(a):
+    """Total number of elements across leaves."""
+    return sum(x.size for x in jax.tree.leaves(a))

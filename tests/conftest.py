@@ -1,0 +1,61 @@
+"""Test harness: run everything on 8 virtual CPU devices.
+
+This is the JAX analogue of the reference's ``local[8]`` Spark master
+(SURVEY.md §4): multi-worker code paths execute for real — shard_map,
+collectives, staggered commits — without TPU hardware.  Must run before any
+jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image preloads jax with JAX_PLATFORMS=axon via a sitecustomize on
+# PYTHONPATH, so the env var alone is too late — force the config too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def blobs_dataset():
+    """Tiny 2-class gaussian-blob classification set, one-hot labels."""
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    n, d = 512, 8
+    y = rng.integers(0, 2, size=n)
+    centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+    x = centers[y] + rng.normal(size=(n, d)).astype(np.float32)
+    return Dataset({
+        "features": x.astype(np.float32),
+        "label": y,
+        "label_encoded": one_hot(y, 2),
+    })
+
+
+@pytest.fixture(scope="session")
+def digits_dataset():
+    """sklearn 8x8 digits — the offline MNIST stand-in for convergence
+    tests (10 classes, 1797 rows)."""
+    from sklearn.datasets import load_digits
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    digits = load_digits()
+    x = (digits.data / 16.0).astype(np.float32)
+    y = digits.target
+    return Dataset({
+        "features": x,
+        "label": y,
+        "label_encoded": one_hot(y, 10),
+    })

@@ -1,0 +1,79 @@
+import jax
+import numpy as np
+
+from dist_keras_tpu.models import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    LayerNorm,
+    MaxPool2D,
+    Sequential,
+    cifar10_convnet,
+    higgs_mlp,
+    mnist_cnn,
+    mnist_mlp,
+    model_from_json,
+)
+
+
+def test_mlp_shapes():
+    m = mnist_mlp(hidden=(32, 16), input_dim=20, num_classes=10)
+    x = np.zeros((4, 20), np.float32)
+    out = m(x)
+    assert out.shape == (4, 10)
+    assert m.output_shape == (10,)
+
+
+def test_cnn_shapes():
+    m = mnist_cnn(input_shape=(28, 28, 1))
+    out = m(np.zeros((2, 28, 28, 1), np.float32))
+    assert out.shape == (2, 10)
+
+
+def test_zoo_builds():
+    assert higgs_mlp().output_shape == (2,)
+    assert cifar10_convnet().output_shape == (10,)
+
+
+def test_json_round_trip():
+    m = mnist_cnn(input_shape=(8, 8, 1))
+    m2 = model_from_json(m.to_json())
+    m2.set_weights(m.get_weights())
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, 1)).astype(np.float32)
+    assert np.allclose(m(x), m2(x), atol=1e-6)
+
+
+def test_weight_list_order_stable():
+    m = Sequential([Dense(4), Dense(2)])
+    m.build((3,))
+    ws = m.get_weights()
+    # kernel, bias, kernel, bias
+    assert [w.shape for w in ws] == [(3, 4), (4,), (4, 2), (2,)]
+    m.set_weights(ws)
+
+
+def test_dropout_train_vs_eval():
+    m = Sequential([Dense(64), Dropout(0.5)])
+    m.build((8,))
+    x = np.ones((4, 8), np.float32)
+    eval_out = m(x)
+    train_out = m(x, training=True, rng=jax.random.PRNGKey(0))
+    assert np.any(np.asarray(train_out) == 0.0)
+    assert not np.allclose(eval_out, train_out)
+
+
+def test_layernorm_and_batchnorm():
+    m = Sequential([Dense(16), LayerNorm(), BatchNorm()])
+    m.build((8,))
+    out = np.asarray(m(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32)))
+    assert out.shape == (4, 16)
+    assert np.isfinite(out).all()
+
+
+def test_pooling():
+    m = Sequential([Conv2D(4, 3, padding="same"), MaxPool2D(2), Flatten()])
+    m.build((8, 8, 1))
+    assert m.output_shape == (4 * 4 * 4,)

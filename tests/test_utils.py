@@ -1,0 +1,81 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_keras_tpu.models import Dense, Sequential, mnist_mlp
+from dist_keras_tpu.utils import (
+    deserialize_model,
+    serialize_model,
+    tree_add,
+    tree_global_norm,
+    tree_mean,
+    tree_scale,
+    tree_size,
+    tree_sub,
+    tree_zeros_like,
+    uniform_weights,
+)
+from dist_keras_tpu.utils.misc import one_hot, to_vector
+
+
+def test_tree_algebra():
+    a = {"w": jnp.ones((2, 2)), "b": jnp.ones(2)}
+    b = tree_scale(a, 2.0)
+    c = tree_add(a, b)
+    assert np.allclose(c["w"], 3.0)
+    d = tree_sub(c, a)
+    assert np.allclose(d["b"], 2.0)
+    z = tree_zeros_like(a)
+    assert np.allclose(z["w"], 0.0)
+    assert tree_size(a) == 6
+    assert np.isclose(float(tree_global_norm(a)), np.sqrt(6.0))
+
+
+def test_tree_mean():
+    trees = [{"w": jnp.full((2,), float(i))} for i in range(3)]
+    m = tree_mean(trees)
+    assert np.allclose(m["w"], 1.0)
+
+
+def test_one_hot_and_to_vector():
+    v = to_vector(3, 5)
+    assert v.shape == (5,) and v[3] == 1 and v.sum() == 1
+    m = one_hot([0, 2, 1], 3)
+    assert m.shape == (3, 3)
+    assert np.array_equal(np.argmax(m, axis=1), [0, 2, 1])
+
+
+def test_serialization_round_trip():
+    m = mnist_mlp(hidden=(16,), input_dim=8, num_classes=3)
+    d = serialize_model(m)
+    assert set(d) == {"model", "weights"}
+    assert isinstance(d["model"], str)
+    m2 = deserialize_model(d)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    assert np.allclose(m.predict(x), m2.predict(x), atol=1e-6)
+
+
+def test_serialization_is_picklable():
+    import pickle
+
+    m = mnist_mlp(hidden=(8,), input_dim=4, num_classes=2)
+    blob = pickle.dumps(serialize_model(m))
+    m2 = deserialize_model(pickle.loads(blob))
+    assert m2.count_params == m.count_params
+
+
+def test_uniform_weights():
+    m = Sequential([Dense(8)])
+    m.build((4,))
+    uniform_weights(m, bounds=(-0.1, 0.1), seed=1)
+    for w in m.get_weights():
+        assert w.max() <= 0.1 and w.min() >= -0.1
+
+
+def test_set_weights_shape_check():
+    m = Sequential([Dense(8)])
+    m.build((4,))
+    ws = m.get_weights()
+    ws[0] = np.zeros((5, 8), np.float32)
+    with pytest.raises(ValueError):
+        m.set_weights(ws)
