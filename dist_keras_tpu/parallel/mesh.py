@@ -28,16 +28,28 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
 
+_MESH_CACHE = {}
+
+
 def worker_mesh(num_workers=None, devices=None):
-    """1-D data-parallel mesh over ``num_workers`` devices."""
-    devices = list(devices if devices is not None else jax.devices())
+    """1-D data-parallel mesh over ``num_workers`` devices.
+
+    Meshes are cached so that equal configurations return the *same* Mesh
+    object — this lets jitted shard_map programs built by different trainer
+    instances share XLA executables (see Trainer._compiled).
+    """
+    devices = tuple(devices if devices is not None else jax.devices())
     if num_workers is None:
         num_workers = len(devices)
     if num_workers > len(devices):
         raise ValueError(
             f"num_workers={num_workers} > available devices {len(devices)}; "
             "on CPU set XLA_FLAGS=--xla_force_host_platform_device_count")
-    return Mesh(np.array(devices[:num_workers]), (WORKER_AXIS,))
+    key = (devices[:num_workers], WORKER_AXIS)
+    if key not in _MESH_CACHE:
+        _MESH_CACHE[key] = Mesh(np.array(devices[:num_workers]),
+                                (WORKER_AXIS,))
+    return _MESH_CACHE[key]
 
 
 def grid_mesh(axis_sizes: dict, devices=None):

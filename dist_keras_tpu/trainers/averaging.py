@@ -29,41 +29,52 @@ except ImportError:  # older jax
 
 
 class AveragingTrainer(DistributedTrainer):
+    def _cache_extras(self):
+        # the epoch count is the outer scan length -> part of the trace
+        return super()._cache_extras() + (self.num_epoch,)
+
     def train(self, dataset, shuffle=False):
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
         mesh = self.mesh
-        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
         num_epoch = self.num_epoch
 
-        def body(params, xs, ys, rng):
-            xs, ys = xs[0], ys[0]  # shard -> local (steps, batch, ...)
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(WORKER_AXIS))
+        def build():
+            step = make_sgd_step(
+                model.apply, loss_fn, tx, self.compute_dtype)
 
-            def epoch(carry, _):
-                params, rng = carry
-                # Local copies must be explicitly worker-varying, else the
-                # backward pass psums gradients globally (see tree_pvary).
-                local = tree_pvary(params)
-                # Fresh worker optimizer each epoch, as the reference
-                # recompiles the model per epoch (trainers.py:~170).
-                opt_state = tx.init(local)
-                (local, _, rng), losses = jax.lax.scan(
-                    step, (local, opt_state, rng), (xs, ys))
-                params = tree_pmean(local)
-                return (params, rng), losses
+            def body(params, xs, ys, rng):
+                xs, ys = xs[0], ys[0]  # shard -> local (steps, batch, ...)
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(WORKER_AXIS))
 
-            (params, _), losses = jax.lax.scan(
-                epoch, (params, rng), None, length=num_epoch)
-            return params, losses[None]  # losses: (1, epochs, steps)
+                def epoch(carry, _):
+                    params, rng = carry
+                    # Local copies must be explicitly worker-varying, else
+                    # the backward pass psums gradients globally (see
+                    # tree_pvary).
+                    local = tree_pvary(params)
+                    # Fresh worker optimizer each epoch, as the reference
+                    # recompiles the model per epoch (trainers.py:~170).
+                    opt_state = tx.init(local)
+                    (local, _, rng), losses = jax.lax.scan(
+                        step, (local, opt_state, rng), (xs, ys))
+                    params = tree_pmean(local)
+                    return (params, rng), losses
 
-        fn = jax.jit(shard_map(
-            body, mesh=mesh,
-            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-            out_specs=(P(), P(WORKER_AXIS)),
-        ))
+                (params, _), losses = jax.lax.scan(
+                    epoch, (params, rng), None, length=num_epoch)
+                return params, losses[None]  # losses: (1, epochs, steps)
+
+            return jax.jit(shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+                out_specs=(P(), P(WORKER_AXIS)),
+            ))
+
+        fn = self._compiled(build)
 
         self.record_training_start()
         params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
@@ -84,37 +95,47 @@ class EnsembleTrainer(DistributedTrainer):
         super().__init__(keras_model, **kw)
         self.num_models = int(num_models)
 
+    def _cache_extras(self):
+        # the epoch count is the outer scan length -> part of the trace
+        return super()._cache_extras() + (self.num_epoch,)
+
     def train(self, dataset, shuffle=False):
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)
         mesh = self.mesh
-        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
         num_epoch = self.num_epoch
 
-        def body(params, xs, ys, rng):
-            xs, ys = xs[0], ys[0]
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(WORKER_AXIS))
-            params = tree_pvary(params)  # independent replicas: keep local
-            opt_state = tx.init(params)
+        def build():
+            step = make_sgd_step(
+                model.apply, loss_fn, tx, self.compute_dtype)
 
-            def epoch(carry, _):
-                params, opt_state, rng = carry
-                (params, opt_state, rng), losses = jax.lax.scan(
-                    step, (params, opt_state, rng), (xs, ys))
-                return (params, opt_state, rng), losses
+            def body(params, xs, ys, rng):
+                xs, ys = xs[0], ys[0]
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(WORKER_AXIS))
+                params = tree_pvary(params)  # independent replicas
+                opt_state = tx.init(params)
 
-            (params, _, _), losses = jax.lax.scan(
-                epoch, (params, opt_state, rng), None, length=num_epoch)
-            stacked = jax.tree.map(lambda x: x[None], params)
-            return stacked, losses[None]
+                def epoch(carry, _):
+                    params, opt_state, rng = carry
+                    (params, opt_state, rng), losses = jax.lax.scan(
+                        step, (params, opt_state, rng), (xs, ys))
+                    return (params, opt_state, rng), losses
 
-        fn = jax.jit(shard_map(
-            body, mesh=mesh,
-            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-            out_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
-        ))
+                (params, _, _), losses = jax.lax.scan(
+                    epoch, (params, opt_state, rng), None, length=num_epoch)
+                stacked = jax.tree.map(lambda x: x[None], params)
+                return stacked, losses[None]
+
+            return jax.jit(shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+                out_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
+            ))
+
+        fn = self._compiled(build)
 
         self.record_training_start()
         stacked, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),

@@ -57,11 +57,65 @@ class Trainer:
         return self._t_stop - self._t_start
 
     def get_history(self):
+        """Per-step training losses.
+
+        Shapes by trainer: SingleTrainer -> (steps,); AveragingTrainer /
+        EnsembleTrainer -> (workers, epochs, steps); windowed family
+        (DOWNPOUR/ADAG/AEASGD/EAMSGD) -> (workers, epochs, windows, W);
+        DynSGD -> (workers, epochs, steps).
+        """
         return self.history
 
     def get_averaged_history(self):
         return float(np.mean(np.asarray(self.history))) if len(
             np.ravel(self.history)) else float("nan")
+
+    # ---- compiled-program cache ----
+    # XLA compilation is expensive (tens of seconds through a remote-compile
+    # tunnel); trainers with equal configuration produce identical traced
+    # programs, so the jitted callables are shared process-wide.  Shape/dtype
+    # changes are handled by jit's own retracing — the key only carries what
+    # changes the *structure* of the traced program.  LRU-bounded: cached
+    # builder closures pin model params, so unbounded growth would leak a
+    # weight copy per hyperparameter-sweep point.
+    _jit_cache = {}
+    _jit_cache_max = 32
+    # Non-string key components are tokened by id(); pin them so a GC'd
+    # object's address can never be reused by a different config.
+    _id_pins = []
+
+    def _cache_extras(self):
+        """Subclass hook: hyperparameters baked into the trace."""
+        return ()
+
+    def _cache_key(self):
+        def _tok(v):
+            if isinstance(v, str):
+                return v
+            Trainer._id_pins.append(v)
+            return f"obj:{id(v)}"
+
+        # num_epoch is deliberately absent: trainers that bake the epoch
+        # count into the trace (epoch-scan) add it via _cache_extras;
+        # trainers that loop epochs on the host must share executables
+        # across different epoch counts.
+        return (type(self).__name__,
+                self.serialized_model["model"],
+                _tok(self.loss), _tok(self.worker_optimizer),
+                tuple(sorted(self.optimizer_kwargs.items())),
+                str(self.compute_dtype),
+                self._cache_extras())
+
+    def _compiled(self, builder):
+        key = self._cache_key()
+        cache = Trainer._jit_cache
+        fn = cache.pop(key, None)
+        if fn is None:
+            fn = builder()
+            while len(cache) >= Trainer._jit_cache_max:
+                cache.pop(next(iter(cache)))  # evict least recently used
+        cache[key] = fn  # (re)insert at the back = most recent
+        return fn
 
     # ---- shared plumbing ----
     def _fresh_model(self):
@@ -94,6 +148,10 @@ class DistributedTrainer(Trainer):
         # master_host/master_port: reference PS kwargs, accepted for parity.
         del master_host, master_port
         self._mesh = mesh
+
+    def _cache_extras(self):
+        custom = id(self._mesh) if self._mesh is not None else None
+        return (self.num_workers, custom)
 
     @property
     def mesh(self):

@@ -14,6 +14,11 @@ counter measures exactly what it does in the reference — how many center
 updates the worker missed.  The commit itself is a masked ``psum`` executed
 every step (zero contribution from non-committing workers), so the whole
 schedule stays one compiled ``lax.scan`` with no data-dependent control flow.
+
+Like the other distributed trainers, epochs loop on the host over
+device-resident data (one H2D transfer), and all per-worker state — local
+replica, pulled snapshot, optimizer state, staleness counters — persists
+across epochs.
 """
 
 from __future__ import annotations
@@ -34,84 +39,105 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
+def _make_body(step, tx, window, num_workers, num_epoch):
+    def body(params, xs, ys, key):
+        xs, ys = xs[0], ys[0]
+        widx = jax.lax.axis_index(WORKER_AXIS)
+        phase = (widx * window) // num_workers  # staggered commit schedule
+
+        center = params
+        # pulled/local/opt_state/last_seen diverge per worker inside the
+        # scan; mark them device-varying up front (see tree_pvary — also
+        # required so local gradients stay local).
+        pulled = tree_pvary(params)
+        local = tree_pvary(params)
+        opt_state = tree_pvary(tx.init(params))
+        last_seen = tree_pvary(jnp.zeros((), jnp.int32))
+        global_count = jnp.zeros((), jnp.int32)
+
+        def one_step(carry, inp):
+            (center, pulled, local, opt_state, rng,
+             last_seen, global_count) = carry
+            t, x, y = inp
+            (local, opt_state, rng), loss = step(
+                (local, opt_state, rng), (x, y))
+
+            commit = ((t + 1 + phase) % window == 0)
+            m = commit.astype(jnp.float32)
+            staleness = (global_count - last_seen).astype(jnp.float32)
+            scale = m / (staleness + 1.0)
+            contribution = jax.tree.map(
+                lambda l, p: scale * (l - p), local, pulled)
+            center = jax.tree.map(
+                lambda c, d: c + d, center, tree_psum(contribution))
+            global_count = global_count + jax.lax.psum(
+                commit.astype(jnp.int32), WORKER_AXIS)
+            # committing workers pull the fresh center
+            local = jax.tree.map(
+                lambda l, c: jnp.where(commit, c, l), local, center)
+            pulled = jax.tree.map(
+                lambda p, c: jnp.where(commit, c, p), pulled, center)
+            last_seen = jnp.where(commit, global_count, last_seen)
+            return (center, pulled, local, opt_state, rng,
+                    last_seen, global_count), loss
+
+        steps = xs.shape[0]
+
+        def epoch(carry, e):
+            (center, pulled, local, opt_state,
+             last_seen, global_count) = carry
+            rng = tree_pvary(jax.random.fold_in(
+                jax.random.fold_in(key, e), widx))
+            ts = jnp.arange(steps) + e * steps
+            state = (center, pulled, local, opt_state, rng,
+                     last_seen, global_count)
+            state, losses = jax.lax.scan(one_step, state, (ts, xs, ys))
+            (center, pulled, local, opt_state, _,
+             last_seen, global_count) = state
+            return (center, pulled, local, opt_state,
+                    last_seen, global_count), losses
+
+        carry = (center, pulled, local, opt_state, last_seen, global_count)
+        carry, losses = jax.lax.scan(epoch, carry, jnp.arange(num_epoch))
+        return carry[0], losses[None]  # (1, epochs, steps)
+
+    return body
+
+
 class DynSGD(DistributedTrainer):
     def __init__(self, keras_model, num_workers=2, communication_window=5,
                  **kw):
         super().__init__(keras_model, num_workers=num_workers, **kw)
         self.communication_window = int(communication_window)
 
+    def _cache_extras(self):
+        return super()._cache_extras() + (
+            self.communication_window, self.num_epoch)
+
     def train(self, dataset, shuffle=False):
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
-        xs = np.tile(xs, (1, self.num_epoch) + (1,) * (xs.ndim - 2))
-        ys = np.tile(ys, (1, self.num_epoch) + (1,) * (ys.ndim - 2))
-
-        W = self.communication_window
-        N = self.num_workers
         mesh = self.mesh
-        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
 
-        def body(params, xs, ys, rng):
-            xs, ys = xs[0], ys[0]
-            widx = jax.lax.axis_index(WORKER_AXIS)
-            rng = jax.random.fold_in(rng, widx)
-            phase = (widx * W) // N  # stagger commits across the window
+        def build():
+            step = make_sgd_step(
+                model.apply, loss_fn, tx, self.compute_dtype)
+            return jax.jit(shard_map(
+                _make_body(step, tx, self.communication_window,
+                           self.num_workers, self.num_epoch),
+                mesh=mesh,
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+                out_specs=(P(), P(WORKER_AXIS)),
+            ))
 
-            center = params
-            # pulled/local/opt_state/last_seen diverge per worker inside the
-            # scan; mark them device-varying up front (see tree_pvary — also
-            # required so local gradients stay local).
-            pulled = tree_pvary(params)
-            local = tree_pvary(params)
-            opt_state = tree_pvary(tx.init(params))
-            last_seen = tree_pvary(jnp.zeros((), jnp.int32))
-            global_count = jnp.zeros((), jnp.int32)
-
-            def one_step(carry, inp):
-                (center, pulled, local, opt_state, rng,
-                 last_seen, global_count) = carry
-                t, x, y = inp
-                (local, opt_state, rng), loss = step(
-                    (local, opt_state, rng), (x, y))
-
-                commit = ((t + 1 + phase) % W == 0)
-                m = commit.astype(jnp.float32)
-                staleness = (global_count - last_seen).astype(jnp.float32)
-                scale = m / (staleness + 1.0)
-                contribution = jax.tree.map(
-                    lambda l, p: scale * (l - p), local, pulled)
-                center = jax.tree.map(
-                    lambda c, d: c + d, center, tree_psum(contribution))
-                global_count = global_count + jax.lax.psum(
-                    commit.astype(jnp.int32), WORKER_AXIS)
-                # committing workers pull the fresh center
-                local = jax.tree.map(
-                    lambda l, c: jnp.where(commit, c, l), local, center)
-                pulled = jax.tree.map(
-                    lambda p, c: jnp.where(commit, c, p), pulled, center)
-                last_seen = jnp.where(commit, global_count, last_seen)
-                return (center, pulled, local, opt_state, rng,
-                        last_seen, global_count), loss
-
-            steps = xs.shape[0]
-            ts = jnp.arange(steps)
-            carry = (center, pulled, local, opt_state, rng,
-                     last_seen, global_count)
-            carry, losses = jax.lax.scan(one_step, carry, (ts, xs, ys))
-            center = carry[0]
-            return center, losses[None]
-
-        fn = jax.jit(shard_map(
-            body, mesh=mesh,
-            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-            out_specs=(P(), P(WORKER_AXIS)),
-        ))
+        fn = self._compiled(build)
 
         self.record_training_start()
         params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
                             jax.random.PRNGKey(self.seed))
         jax.block_until_ready(params)
         self.record_training_end()
+        # history: (workers, epochs, steps)
         return self._finalize(params, np.asarray(losses).tolist())

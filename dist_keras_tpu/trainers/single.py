@@ -24,14 +24,21 @@ class SingleTrainer(Trainer):
         xb, yb = dataset.batches(
             self.batch_size, self.features_col, self.label_col)
 
-        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
         params = model.params
         opt_state = tx.init(params)
         rng = jax.random.PRNGKey(self.seed)
 
-        @jax.jit
-        def run_epoch(params, opt_state, rng, xb, yb):
-            return scan_epoch(step, params, opt_state, rng, xb, yb)
+        def build():
+            step = make_sgd_step(
+                model.apply, loss_fn, tx, self.compute_dtype)
+
+            @jax.jit
+            def run_epoch(params, opt_state, rng, xb, yb):
+                return scan_epoch(step, params, opt_state, rng, xb, yb)
+
+            return run_epoch
+
+        run_epoch = self._compiled(build)
 
         xb = jnp.asarray(xb)
         yb = jnp.asarray(yb)

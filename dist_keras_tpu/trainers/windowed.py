@@ -29,6 +29,8 @@ per window riding ICI.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -62,6 +64,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         self.communication_window = int(communication_window)
         self.parallelism_factor = int(parallelism_factor)
 
+    def _cache_extras(self):
+        # num_epoch is the outer scan length -> part of the trace
+        return super()._cache_extras() + (
+            self.communication_window, self.parallelism_factor,
+            self.num_epoch)
+
     # --- strategy hooks -------------------------------------------------
     def wrap_optimizer(self, tx):
         return tx
@@ -73,65 +81,92 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     # --- shared training loop ------------------------------------------
     def train(self, dataset, shuffle=False):
+        """One H2D transfer, one dispatch: epochs are an outer ``lax.scan``
+        over the same device-resident shard tensors (no tiling, no
+        re-transfer).  Worker state (local replicas, optimizer state)
+        persists across epochs, exactly as a long-lived reference worker's
+        does (workers.py:~150)."""
         model, loss_fn, tx = self._resolve()
         tx = self.wrap_optimizer(tx)
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
 
-        W = self.communication_window
-        steps = xs.shape[1] * self.num_epoch
-        windows = max(steps // W, 1)
-        if steps < W:
-            W = steps
-        # Tile epochs along the step axis, then cut into whole windows
-        # (remainder dropped, like the reference's fixed batching).
-        xs = np.tile(xs, (1, self.num_epoch) + (1,) * (xs.ndim - 2))
-        ys = np.tile(ys, (1, self.num_epoch) + (1,) * (ys.ndim - 2))
+        W = min(self.communication_window, xs.shape[1])
+        windows = xs.shape[1] // W
+        # Whole windows only, cut per epoch (remainder dropped every epoch,
+        # like the reference's fixed mini-batching) — warn so silent data
+        # loss / window shrinkage is visible.
+        if W < self.communication_window:
+            warnings.warn(
+                f"communication_window={self.communication_window} > "
+                f"{xs.shape[1]} steps per worker per epoch; effective "
+                f"window shrunk to {W}", stacklevel=2)
+        dropped = xs.shape[1] - windows * W
+        if dropped:
+            warnings.warn(
+                f"dropping {dropped} trailing step(s) per worker per epoch "
+                f"(not a whole communication window)", stacklevel=2)
         xs = xs[:, :windows * W].reshape(
             self.num_workers, windows, W, *xs.shape[2:])
         ys = ys[:, :windows * W].reshape(
             self.num_workers, windows, W, *ys.shape[2:])
 
         mesh = self.mesh
-        step = make_sgd_step(model.apply, loss_fn, tx, self.compute_dtype)
         merge = self.merge
+        num_epoch = self.num_epoch
 
-        def body(params, xs, ys, rng):
-            xs, ys = xs[0], ys[0]  # (windows, W, batch, ...)
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(WORKER_AXIS))
-            center = params
-            # Local replica state must be explicitly worker-varying or the
-            # backward pass silently psums gradients (see tree_pvary).
-            local = tree_pvary(params)
-            opt_state = tx.init(local)
+        def build():
+            step = make_sgd_step(
+                model.apply, loss_fn, tx, self.compute_dtype)
 
-            def window(carry, batch):
-                center, local, opt_state, rng = carry
-                xw, yw = batch
-                (local, opt_state, rng), losses = jax.lax.scan(
-                    step, (local, opt_state, rng), (xw, yw))
-                center, local = merge(center, local)
-                # merges that reset local to the (replicated) center must
-                # hand back a varying-typed local for the next window
-                local = tree_pvary(local)
-                return (center, local, opt_state, rng), losses
+            def body(params, xs, ys, key):
+                xs, ys = xs[0], ys[0]  # (windows, W, batch, ...)
+                widx = jax.lax.axis_index(WORKER_AXIS)
+                center = params
+                # Local replica state must be explicitly worker-varying or
+                # the backward pass silently psums gradients (tree_pvary).
+                local = tree_pvary(params)
+                opt_state = tx.init(local)
 
-            (center, _, _, _), losses = jax.lax.scan(
-                window, (center, local, opt_state, rng), (xs, ys))
-            return center, losses[None]
+                def window(carry, batch):
+                    center, local, opt_state, rng = carry
+                    xw, yw = batch
+                    (local, opt_state, rng), losses = jax.lax.scan(
+                        step, (local, opt_state, rng), (xw, yw))
+                    center, local = merge(center, local)
+                    # merges that reset local to the (replicated) center
+                    # must hand back a varying-typed local for next window
+                    local = tree_pvary(local)
+                    return (center, local, opt_state, rng), losses
 
-        fn = jax.jit(shard_map(
-            body, mesh=mesh,
-            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-            out_specs=(P(), P(WORKER_AXIS)),
-        ))
+                def epoch(carry, e):
+                    center, local, opt_state = carry
+                    rng = tree_pvary(jax.random.fold_in(
+                        jax.random.fold_in(key, e), widx))
+                    (center, local, opt_state, _), losses = jax.lax.scan(
+                        window, (center, local, opt_state, rng), (xs, ys))
+                    return (center, local, opt_state), losses
+
+                (center, _, _), losses = jax.lax.scan(
+                    epoch, (center, local, opt_state),
+                    jnp.arange(num_epoch))
+                return center, losses[None]  # (1, epochs, windows, W)
+
+            return jax.jit(shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+                out_specs=(P(), P(WORKER_AXIS)),
+            ))
+
+        fn = self._compiled(build)
 
         self.record_training_start()
         params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
                             jax.random.PRNGKey(self.seed))
         jax.block_until_ready(params)
         self.record_training_end()
+        # history: (workers, epochs, windows, W)
         return self._finalize(params, np.asarray(losses).tolist())
 
 
@@ -175,6 +210,9 @@ class AEASGD(AsynchronousDistributedTrainer):
         self.rho = float(rho)
         self.learning_rate = float(learning_rate)
 
+    def _cache_extras(self):
+        return super()._cache_extras() + (self.rho, self.learning_rate)
+
     def merge(self, center, local):
         alpha = self.learning_rate * self.rho
         elastic = tree_scale(tree_sub(local, center), alpha)
@@ -191,6 +229,9 @@ class EAMSGD(AEASGD):
     def __init__(self, keras_model, momentum=0.9, **kw):
         super().__init__(keras_model, **kw)
         self.momentum = float(momentum)
+
+    def _cache_extras(self):
+        return super()._cache_extras() + (self.momentum,)
 
     def wrap_optimizer(self, tx):
         return optax.chain(

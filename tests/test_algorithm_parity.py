@@ -143,7 +143,7 @@ def test_workers_actually_diverge_between_commits():
                  optimizer_kwargs={"learning_rate": 0.1},
                  batch_size=BATCH, num_epoch=1, label_col="label_encoded")
     t.train(ds)
-    losses = np.asarray(t.history)  # (workers, windows, W)
+    losses = np.asarray(t.history)  # (workers, epochs, windows, W)
     # Workers see different shards: by the last step their losses differ.
-    last = losses[:, -1, -1]
+    last = losses[:, -1, -1, -1]
     assert np.unique(np.round(last, 6)).size > 1
