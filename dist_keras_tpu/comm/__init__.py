@@ -1,0 +1,17 @@
+from dist_keras_tpu.comm.backend import (
+    barrier,
+    fetch_global,
+    global_devices,
+    initialize,
+    is_multi_host,
+    local_data_slice,
+    local_devices,
+    num_processes,
+    process_index,
+)
+
+__all__ = [
+    "initialize", "num_processes", "process_index", "is_multi_host",
+    "local_devices", "global_devices", "local_data_slice", "barrier",
+    "fetch_global",
+]

@@ -1,0 +1,116 @@
+"""Distributed communication backend — multi-host scale-out.
+
+Role in the architecture: the reference scales out with Spark executors plus
+a hand-rolled pickle-over-TCP parameter server (``networking.py`` +
+``parameter_servers.py``).  On TPU, scale-out is ``jax.distributed`` over
+DCN for the control plane and XLA collectives over ICI/DCN for the data
+plane; this module is the thin host-side layer that stands where the
+reference's socket plumbing stood:
+
+- ``initialize``: process-group bring-up (maps to the PS bind/connect dance,
+  networking.py:~35).
+- ``local_data_slice``: which rows of a global dataset this host feeds — the
+  multi-host analogue of the trainer's repartition-to-workers step
+  (trainers.py:~365).
+- ``barrier``: a psum over all devices, replacing ad-hoc socket round-trips.
+- ``fetch_global``: host-side all-gather for metrics/history aggregation
+  (what the reference got from Spark's collect()).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_initialized = False
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               **kw):
+    """Bring up the multi-host process group (no-op when single-process).
+
+    Mirrors ``jax.distributed.initialize``.  With no arguments it falls back
+    to the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` environment variables — exactly what
+    ``launch.Job.launch`` exports on each pod host — and is a safe no-op
+    when neither arguments nor environment are present, so the same training
+    script works from a laptop CPU to a multi-host pod.
+    """
+    import os
+
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single-process mode: nothing to do
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id, **kw)
+    _initialized = True
+
+
+def num_processes():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def is_multi_host():
+    return jax.process_count() > 1
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def global_devices():
+    return jax.devices()
+
+
+def local_data_slice(n_rows, process=None, count=None):
+    """Row range [start, stop) this host should load from a global dataset
+    of ``n_rows`` (contiguous split, same dealing order as worker_shards)."""
+    process = jax.process_index() if process is None else process
+    count = jax.process_count() if count is None else count
+    per = n_rows // count
+    start = process * per
+    stop = n_rows if process == count - 1 else start + per
+    return start, stop
+
+
+def barrier():
+    """Block until every device reaches this point (one tiny cross-device
+    reduction; the float() forces host-side completion)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("i",))
+    x = jax.device_put(jnp.ones((len(devs),)), NamedSharding(mesh, P("i")))
+    return float(jnp.sum(x))
+
+
+def fetch_global(tree):
+    """Device pytree -> host numpy pytree (full value on every host).
+
+    With jax's global arrays, addressable shards are materialized and
+    non-addressable ones fetched via allgather under the hood of
+    ``jax.experimental.multihost_utils`` when multi-host.
+    """
+    if is_multi_host():  # pragma: no cover - needs real multi-host
+        from jax.experimental import multihost_utils
+
+        return jax.tree.map(
+            multihost_utils.process_allgather, tree)
+    return jax.tree.map(np.asarray, tree)

@@ -1,0 +1,3 @@
+from dist_keras_tpu.launch.job import Job, Punchcard
+
+__all__ = ["Job", "Punchcard"]

@@ -1,0 +1,96 @@
+"""Keras 3 (JAX backend) model adapter.
+
+The reference's user contract is "hand the trainer a Keras model"
+(``Trainer(keras_model, ...)``, trainers.py:~35).  Our native ``Sequential``
+covers the reference's model zoo, but real Keras 3 models are also accepted
+through this adapter: with ``KERAS_BACKEND=jax``, ``model.stateless_call``
+exposes the model as a pure function of its variable lists — exactly the
+``apply(params, x)`` contract every trainer here consumes — so arbitrary
+Keras architectures train on the TPU mesh unchanged.
+
+Limitations (round 1): non-trainable variables (BatchNorm moving stats,
+seed generators) are captured at wrap time and held constant during
+training — fine for the reference's model families, which have none.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _import_keras():
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            "Keras is loaded with backend "
+            f"{keras.backend.backend()!r}; the adapter needs "
+            "KERAS_BACKEND=jax (set it before importing keras)")
+    return keras
+
+
+class KerasModelAdapter:
+    """Wraps a built Keras 3 model into the framework's model contract:
+    ``params`` pytree + pure ``apply`` + weight/JSON round-trip."""
+
+    def __init__(self, keras_model):
+        import jax.numpy as jnp
+
+        keras = _import_keras()
+        if not keras_model.built:
+            raise ValueError("build the Keras model first (call it once "
+                             "or specify an Input layer)")
+        self._model = keras_model
+        self.params = [jnp.asarray(np.asarray(v))
+                       for v in keras_model.trainable_variables]
+        self._non_trainable = [jnp.asarray(np.asarray(v))
+                               for v in keras_model.non_trainable_variables]
+        self.name = keras_model.name
+
+    # ---- trainer contract -------------------------------------------
+    def apply(self, params, x, *, training=False, rng=None):
+        outputs, _ = self._model.stateless_call(
+            params, self._non_trainable, x, training=training)
+        return outputs
+
+    def set_params(self, params):
+        import jax.numpy as jnp
+
+        self.params = [jnp.asarray(np.asarray(p)) for p in params]
+        for var, val in zip(self._model.trainable_variables, self.params):
+            var.assign(np.asarray(val))
+
+    # ---- serialization contract (utils.py:~40 dict shape) ------------
+    def to_json(self):
+        return self._model.to_json()
+
+    def get_weights(self):
+        return [np.asarray(p) for p in self.params]
+
+    def set_weights(self, weights):
+        self.set_params(list(weights))
+
+    def __call__(self, x, *, training=False, rng=None):
+        return self.apply(self.params, x, training=training, rng=rng)
+
+    def predict(self, x, batch_size=None):
+        return np.asarray(self(np.asarray(x)))
+
+    @property
+    def count_params(self):
+        return sum(int(np.prod(np.shape(w))) for w in self.get_weights())
+
+
+def from_keras_json(js, weights=None):
+    """Rebuild an adapter from Keras architecture JSON (+ weight list)."""
+    keras = _import_keras()
+    model = keras.models.model_from_json(js)
+    if not model.built:
+        model.build(None)
+    adapter = KerasModelAdapter(model)
+    if weights is not None:
+        adapter.set_weights(weights)
+    return adapter

@@ -1,0 +1,144 @@
+"""Transformer models (single-device reference implementation).
+
+New capability surface — the reference has no attention or sequence models
+(SURVEY.md §2.3).  This is the flagship architecture for the framework's
+long-context path: the same parameter pytree layout is consumed by the
+sharded dp x tp x sp training step in ``parallel/transformer_tp.py``, and
+this implementation is the correctness oracle its tests compare against.
+
+Layout notes (TPU-first):
+- attention projections keep an explicit head axis: wq/wk/wv are
+  (d_model, heads, head_dim) and wo is (heads, head_dim, d_model) so the
+  head axis can be sharded over the ``model`` mesh axis without reshapes;
+- MLP is d -> ff (gelu) -> d, column/row-shardable;
+- pre-LN residual blocks; mean-pool + linear head for classification.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_keras_tpu.models.layers import glorot_uniform
+from dist_keras_tpu.ops.attention import attention
+
+
+def transformer_config(input_dim, seq_len, d_model=64, n_heads=4,
+                       n_layers=2, d_ff=None, n_classes=2):
+    return {
+        "input_dim": int(input_dim),
+        "seq_len": int(seq_len),
+        "d_model": int(d_model),
+        "n_heads": int(n_heads),
+        "n_layers": int(n_layers),
+        "d_ff": int(d_ff if d_ff is not None else 4 * d_model),
+        "n_classes": int(n_classes),
+    }
+
+
+def init_transformer_params(key, cfg):
+    """-> params pytree (dict), replicated layout shared with the TP step."""
+    d, h = cfg["d_model"], cfg["n_heads"]
+    dh = d // h
+    ff = cfg["d_ff"]
+    keys = iter(jax.random.split(key, 6 + 8 * cfg["n_layers"]))
+
+    def dense(shape):
+        return glorot_uniform(next(keys), shape)
+
+    params = {
+        "proj": dense((cfg["input_dim"], d)),
+        "pos": 0.02 * jax.random.normal(next(keys),
+                                        (cfg["seq_len"], d)),
+        "blocks": [],
+        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "head": {"kernel": dense((d, cfg["n_classes"])),
+                 "bias": jnp.zeros((cfg["n_classes"],))},
+    }
+    for _ in range(cfg["n_layers"]):
+        params["blocks"].append({
+            "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "wq": dense((d, h, dh)),
+            "wk": dense((d, h, dh)),
+            "wv": dense((d, h, dh)),
+            "wo": dense((h, dh, d)),
+            "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "w1": dense((d, ff)),
+            "b1": jnp.zeros((ff,)),
+            "w2": dense((ff, d)),
+            "b2": jnp.zeros((d,)),
+        })
+    return params
+
+
+def layer_norm(p, x, eps=1e-5):
+    """Shared by the single-device oracle and the sharded TP step — keep
+    one definition so they can never silently diverge."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+_ln = layer_norm
+
+
+def transformer_apply(params, x, cfg, *, causal=False, attn_fn=attention):
+    """Forward pass.  x: (B, T, input_dim) -> logits (B, n_classes).
+
+    ``attn_fn`` is injectable so the sharded step can swap in
+    ``ring_attention`` while reusing every other line of this function.
+    """
+    h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
+    for blk in params["blocks"]:
+        y = _ln(blk["ln1"], h)
+        q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
+        k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
+        v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
+        a = attn_fn(q, k, v, causal=causal)
+        h = h + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
+        y = _ln(blk["ln2"], h)
+        u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
+        h = h + u @ blk["w2"] + blk["b2"]
+    pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
+    return pooled @ params["head"]["kernel"] + params["head"]["bias"]
+
+
+class Transformer:
+    """Model-contract wrapper (params + apply + weights round-trip) so the
+    standard trainers accept a Transformer like any other model."""
+
+    def __init__(self, cfg=None, seed=0, **cfg_kw):
+        self.cfg = cfg or transformer_config(**cfg_kw)
+        self.params = init_transformer_params(
+            jax.random.PRNGKey(seed), self.cfg)
+        self.name = "transformer"
+
+    def apply(self, params, x, *, training=False, rng=None):
+        return transformer_apply(params, x, self.cfg)
+
+    def __call__(self, x, *, training=False, rng=None):
+        return self.apply(self.params, jnp.asarray(x))
+
+    def predict(self, x, batch_size=None):
+        return np.asarray(self(np.asarray(x)))
+
+    def set_params(self, params):
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def get_weights(self):
+        return [np.asarray(l) for l in jax.tree.leaves(self.params)]
+
+    def set_weights(self, weights):
+        treedef = jax.tree.structure(self.params)
+        self.params = jax.tree.unflatten(
+            treedef, [jnp.asarray(w) for w in weights])
+
+    def to_json(self):
+        import json
+
+        return json.dumps({"class_name": "Transformer", "config": self.cfg})
+
+    @property
+    def count_params(self):
+        return sum(int(np.prod(np.shape(w))) for w in self.get_weights())

@@ -1,0 +1,115 @@
+"""Attention ops: single-device reference + ring attention (sequence
+parallelism over the ICI mesh).
+
+New capability surface relative to the reference (SURVEY.md §2.3: no
+attention, no sequence models anywhere in dist-keras) — built TPU-first:
+
+- ``attention``: plain fused softmax(QK^T)V in jnp; XLA fuses this well for
+  moderate sequence lengths.  Shapes are (batch, seq, heads, head_dim).
+- ``ring_attention``: blockwise attention over a named mesh axis.  Each
+  device holds one sequence block of Q/K/V; K/V blocks rotate around the
+  ring with ``ppermute`` while an online-softmax accumulator (running max,
+  denominator, numerator — the flash-attention recurrence) folds in one
+  block per ring step.  Peak memory is O(block^2) instead of O(seq^2) and
+  the permute overlaps with the block matmuls on TPU.  Call it INSIDE
+  ``shard_map`` with the sequence axis bound (see tests and
+  ``parallel/transformer_tp.py``).
+
+Causal masking uses *global* positions, so the sharded result matches the
+single-device reference bit-for-bit up to reduction order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_keras_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Reference attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _block_attend(q, k, v, acc, q_start, kv_start, causal, scale):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    acc = (m, l, o): running max (B,H,T,1), denominator (B,H,T,1),
+    unnormalised output (B,T,H,D).  Positions are global offsets used for
+    the causal mask.
+    """
+    m, l, o = acc
+    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale  # (B,H,Tq,Tk)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_start + jnp.arange(tq)
+        kpos = kv_start + jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+
+    m_block = jnp.max(logits, axis=-1, keepdims=True)      # (B,H,Tq,1)
+    m_new = jnp.maximum(m, m_block)
+    # rescale previous accumulator; fold in the new block
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new)                            # (B,H,Tq,Tk)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = (o * jnp.moveaxis(correction, 1, 2)
+             + jnp.einsum("bhts,bshd->bthd", p, v))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis=SEQ_AXIS, causal=False, scale=None):
+    """Sequence-parallel attention inside shard_map.
+
+    q,k,v: local blocks (B, T_local, H, D); the full sequence is the
+    concatenation of blocks along the ``axis`` mesh dimension in device
+    order.  Returns the local (B, T_local, H, D) output block.
+    """
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_local = q.shape[1]
+    q_start = idx * t_local
+
+    b, t, h, _ = q.shape
+
+    # accumulators must carry q's full varying set (inside a multi-axis
+    # mesh q may vary over batch/model axes too, not just `axis`)
+    def _match_vma(x):
+        want = getattr(jax.typeof(q), "vma", frozenset())
+        have = getattr(jax.typeof(x), "vma", frozenset())
+        missing = tuple(sorted(want - have))
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    m = _match_vma(jnp.full((b, h, t, 1), _NEG_INF, q.dtype))
+    l = _match_vma(jnp.zeros((b, h, t, 1), q.dtype))
+    o = _match_vma(jnp.zeros_like(q))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def ring_step(r, carry):
+        m, l, o, k, v = carry
+        # K/V currently held here originated on device (idx - r) mod n.
+        kv_start = ((idx - r) % n) * t_local
+        m, l, o = _block_attend(
+            q, k, v, (m, l, o), q_start, kv_start, causal, scale)
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return m, l, o, k, v
+
+    m, l, o, k, v = lax.fori_loop(0, n, ring_step, (m, l, o, k, v))
+    # normalise; fully-masked rows (l == 0) produce zeros, not NaNs
+    l_t = jnp.moveaxis(l, 1, 2)  # (B,T,H,1)
+    return jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-30), 0.0)

@@ -1,0 +1,192 @@
+"""Composite dp x tp x sp transformer training step.
+
+This is the framework's scale-out showcase: one jitted ``shard_map`` over a
+3-D mesh ``(workers, model, seq)`` that combines every parallelism the
+framework implements —
+
+- **data parallelism** (``workers``): batch sharded; gradient psum comes out
+  of AD automatically (the replicated->varying promotion of shared params
+  transposes to a psum over every axis that promoted them);
+- **tensor parallelism** (``model``): attention heads and MLP hidden units
+  Megatron-split — wq/wk/wv/wo shard the head axis, w1 column-/w2
+  row-parallel with a single psum after each block half;
+- **sequence parallelism** (``seq``): activations sharded along tokens; the
+  attention inner loop is ``ring_attention`` (K/V blocks rotate on ICI with
+  an online-softmax accumulator).
+
+The single-device oracle is ``models/transformer.py``; the TP/SP step reuses
+its parameter layout, so the tests can assert the sharded loss and the
+sharded gradients match the unsharded reference numerically.
+
+New capability relative to dist-keras (SURVEY.md §2.3: TP/SP/long-context
+all absent upstream).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dist_keras_tpu.models.transformer import (
+    init_transformer_params,
+    layer_norm as _ln,
+)
+from dist_keras_tpu.ops.attention import ring_attention
+from dist_keras_tpu.parallel.mesh import MODEL_AXIS, SEQ_AXIS, WORKER_AXIS, grid_mesh
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def make_tp_mesh(dp=1, tp=1, sp=1, devices=None):
+    """3-D mesh; tp/sp axes last so they ride the fastest ICI links."""
+    return grid_mesh({WORKER_AXIS: dp, MODEL_AXIS: tp, SEQ_AXIS: sp},
+                     devices=devices)
+
+
+def param_specs(params):
+    """PartitionSpec pytree: head axis / ff axis over ``model``, everything
+    else replicated (LN, embeddings, head — small)."""
+
+    def spec_block(blk):
+        return {
+            "ln1": {"scale": P(), "bias": P()},
+            "wq": P(None, MODEL_AXIS, None),
+            "wk": P(None, MODEL_AXIS, None),
+            "wv": P(None, MODEL_AXIS, None),
+            "wo": P(MODEL_AXIS, None, None),
+            "ln2": {"scale": P(), "bias": P()},
+            "w1": P(None, MODEL_AXIS),
+            "b1": P(MODEL_AXIS),
+            "w2": P(MODEL_AXIS, None),
+            "b2": P(),
+        }
+
+    return {
+        "proj": P(),
+        "pos": P(),
+        "blocks": [spec_block(b) for b in params["blocks"]],
+        "ln_f": {"scale": P(), "bias": P()},
+        "head": {"kernel": P(), "bias": P()},
+    }
+
+
+def tp_transformer_forward(params, x, cfg, causal=False):
+    """Sharded forward: call inside shard_map over (workers, model, seq).
+
+    x: local activation block (B_local, T_local, input_dim).
+    Returns logits (B_local, n_classes), replicated over model+seq axes.
+    """
+    t_local = x.shape[1]
+    seq_idx = lax.axis_index(SEQ_AXIS)
+    pos = lax.dynamic_slice_in_dim(
+        params["pos"], seq_idx * t_local, t_local, axis=0)
+    h = x @ params["proj"] + pos[None]
+    for blk in params["blocks"]:
+        y = _ln(blk["ln1"], h)
+        # local heads only: wq/wk/wv are head-sharded over `model`
+        q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
+        k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
+        v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
+        a = ring_attention(q, k, v, axis=SEQ_AXIS, causal=causal)
+        # partial over local heads -> reduce over the model axis
+        o = jnp.einsum("bthk,hkd->btd", a, blk["wo"])
+        h = h + lax.psum(o, MODEL_AXIS)
+        y = _ln(blk["ln2"], h)
+        u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])  # column-parallel
+        z = u @ blk["w2"]                           # row-parallel
+        h = h + lax.psum(z, MODEL_AXIS) + blk["b2"]
+    pooled_local = jnp.sum(_ln(params["ln_f"], h), axis=1)
+    pooled = lax.psum(pooled_local, SEQ_AXIS) / cfg["seq_len"]
+    return pooled @ params["head"]["kernel"] + params["head"]["bias"]
+
+
+def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
+                       causal=False):
+    """-> (step_fn, init_fn).
+
+    init_fn(seed) -> (params, opt_state) on host.
+    step_fn(params, opt_state, x, y) -> (params, opt_state, loss).
+      x: (batch, seq_len, input_dim) global; y: (batch,) int labels.
+    """
+    tx = optimizer or optax.adam(1e-3)
+
+    def body(params, opt_state, x, y):
+        # x local block: (B/workers, T/seq, input_dim); y: (B/workers,)
+
+        def loss_fn(p):
+            logits = tp_transformer_forward(p, x, cfg, causal=causal)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+            # mean over the data-parallel axis -> AD emits the grad psums
+            return lax.pmean(nll, WORKER_AXIS)
+
+        loss_val, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss_val
+
+    def init_fn(seed=0):
+        params = init_transformer_params(jax.random.PRNGKey(seed), cfg)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    def _opt_specs(params, pspecs, opt_state):
+        """Optimizer-state specs: each state leaf inherits the spec of the
+        param with the same global shape (adam's mu/nu mirror the param
+        tree leaf-for-leaf); scalar counters replicate.  Shape collisions
+        across *different* specs would be ambiguous -> hard error."""
+        shape_to_spec = {}
+        for arr, sp in zip(
+                jax.tree.leaves(params),
+                jax.tree.leaves(pspecs,
+                                is_leaf=lambda s: isinstance(s, P))):
+            shape = tuple(np.shape(arr))
+            if shape in shape_to_spec and shape_to_spec[shape] != sp:
+                raise ValueError(
+                    f"ambiguous sharding for shape {shape}: "
+                    f"{shape_to_spec[shape]} vs {sp}; choose distinct "
+                    "d_model/d_ff/seq_len sizes")
+            shape_to_spec[shape] = sp
+        return jax.tree.map(
+            lambda leaf: shape_to_spec.get(tuple(np.shape(leaf)), P()),
+            opt_state)
+
+    def step_fn_factory(params, opt_state):
+        pspecs = param_specs(params)
+        ospecs = _opt_specs(params, pspecs, opt_state)
+        data_x = P(WORKER_AXIS, SEQ_AXIS, None)
+        data_y = P(WORKER_AXIS)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, ospecs, data_x, data_y),
+            out_specs=(pspecs, ospecs, P()),
+        ))
+
+    return step_fn_factory, init_fn
+
+
+def train_tp_transformer(mesh, cfg, x, y, steps=10, optimizer=None,
+                         seed=0, causal=False):
+    """Convenience host loop: compile once, run ``steps`` full-batch updates.
+
+    x: (N, seq_len, input_dim); y: (N,) int labels.  N must divide by the
+    mesh's ``workers`` size and seq_len by its ``seq`` size.
+    """
+    step_factory, init_fn = make_tp_train_step(
+        mesh, cfg, optimizer=optimizer, causal=causal)
+    params, opt_state = init_fn(seed)
+    fn = step_factory(params, opt_state)
+    losses = []
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    for _ in range(steps):
+        params, opt_state, loss_val = fn(params, opt_state, xd, yd)
+        losses.append(float(loss_val))
+    return params, losses

@@ -1,0 +1,62 @@
+"""Profiling / tracing hooks — the §5 "tracing" subsystem.
+
+The reference's only instrumentation is trainer wall-clock timing
+(``record_training_start/stop``, trainers.py:~60), which our Trainer base
+already reproduces.  This module adds the TPU-native layer on top:
+
+- ``trace(logdir)``: context manager around ``jax.profiler`` producing an
+  XProf/TensorBoard trace of everything inside (compiled steps, collectives,
+  transfers).
+- ``annotate(name)``: named region that shows up inside the trace.
+- ``StepTimer``: cheap host-side per-call timer with summary stats, for
+  loops the profiler would be too heavy for.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """Capture a device trace into ``logdir`` (view with TensorBoard)."""
+    jax.profiler.start_trace(str(logdir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    def __init__(self):
+        self.times = []
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+        return False
+
+    def summary(self):
+        arr = np.asarray(self.times)
+        if arr.size == 0:
+            return {"count": 0}
+        return {
+            "count": int(arr.size),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+            "total_s": float(arr.sum()),
+        }

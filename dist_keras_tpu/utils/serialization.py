@@ -33,12 +33,35 @@ def serialize_model(model):
 
 
 def deserialize_model(d):
-    """dict -> Model, same contract as utils.py:~55."""
+    """dict -> Model, same contract as utils.py:~55.
+
+    Native ``Sequential`` JSON deserializes directly; anything else is
+    treated as Keras 3 architecture JSON and comes back wrapped in
+    ``KerasModelAdapter`` (same trainer-facing contract).
+    """
+    import json
+
     from dist_keras_tpu.models.model import model_from_json
 
-    model = model_from_json(d["model"])
-    model.set_weights(d["weights"])
-    return model
+    arch = json.loads(d["model"])
+    if arch.get("class_name") == "Transformer":
+        from dist_keras_tpu.models.transformer import Transformer
+
+        model = Transformer(cfg=arch["config"])
+        model.set_weights(d["weights"])
+        return model
+    if arch.get("class_name") == "Sequential" and "layers" in arch and all(
+            "class_name" in spec for spec in arch["layers"]):
+        try:
+            model = model_from_json(d["model"])
+        except KeyError:
+            model = None  # layer classes not ours -> fall through to Keras
+        if model is not None:
+            model.set_weights(d["weights"])
+            return model
+    from dist_keras_tpu.models.keras_adapter import from_keras_json
+
+    return from_keras_json(d["model"], d["weights"])
 
 
 # Reference-spelled aliases so a dist-keras user finds the names they know.
