@@ -1,0 +1,6 @@
+from dist_keras_tpu.ops.pallas.flash_attention import (
+    attention_auto,
+    flash_attention,
+)
+
+__all__ = ["flash_attention", "attention_auto"]
