@@ -2,9 +2,10 @@
 
 The reference reads CSVs through Spark (``examples/mnist.py`` loads MNIST
 CSVs into a DataFrame).  Here ingestion happens on the TPU host: a native C++
-parser (``data/native/fastcsv.cpp``, loaded via ctypes) parses numeric CSVs
-multi-threaded straight into a preallocated float32 matrix; pandas is the
-fallback when the extension isn't built or the file isn't purely numeric.
+parser (``data/native/fastcsv.cpp``, loaded via ctypes) scans the file once
+into an opaque handle, then parses rows multi-threaded straight into a
+numpy-preallocated float32 matrix (no extra copy); pandas is the fallback
+when the extension isn't built or the file isn't purely numeric.
 """
 
 from __future__ import annotations
@@ -45,17 +46,20 @@ def _read_native(lib, path, has_header, dtype):
 
     rows = ctypes.c_longlong()
     cols = ctypes.c_longlong()
-    rc = lib.fastcsv_dims(path.encode(), int(has_header),
-                          ctypes.byref(rows), ctypes.byref(cols))
-    if rc != 0:
-        raise IOError(f"fastcsv_dims failed rc={rc} on {path}")
+    handle = lib.fastcsv_scan(path.encode(), int(has_header),
+                              ctypes.byref(rows), ctypes.byref(cols))
+    if not handle:
+        raise IOError(f"fastcsv_scan failed on {path}")
     out = np.empty((rows.value, cols.value), dtype=np.float32)
-    rc = lib.fastcsv_parse(
-        path.encode(), int(has_header),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        rows.value, cols.value)
-    if rc != 0:
-        raise IOError(f"fastcsv_parse failed rc={rc} on {path}")
+    if rows.value == 0 or cols.value == 0:
+        lib.fastcsv_release(handle)
+    else:
+        # extract frees the handle (success or failure)
+        rc = lib.fastcsv_extract(
+            handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.value, cols.value)
+        if rc != 0:
+            raise IOError(f"fastcsv_extract failed rc={rc} on {path}")
     if names is None:
         names = [f"c{i}" for i in range(cols.value)]
     return out.astype(dtype, copy=False), names

@@ -1,35 +1,54 @@
-"""Native extension loader: builds fastcsv.so on first use with g++.
+"""Native extension loader: builds fastcsv from source with g++.
 
 No pybind11 in the image, so the binding is a plain C ABI consumed through
 ctypes (see csv.py).  Build failures degrade gracefully — callers fall back
 to pandas.
+
+The build artifact is keyed on a hash of the source (``fastcsv-<hash>.so``)
+so a source fix can never be shadowed by a stale cached binary; prebuilt
+binaries are never checked in (see .gitignore).
 """
 
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastcsv.cpp")
-_SO = os.path.join(_HERE, "fastcsv.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False
 
 
+def _so_path():
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_HERE, f"fastcsv-{digest}.so")
+
+
 def build_fastcsv(force=False):
-    """Compile fastcsv.cpp -> fastcsv.so. Returns path or None."""
-    if os.path.exists(_SO) and not force and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
+    """Compile fastcsv.cpp -> fastcsv-<srchash>.so. Returns path or None."""
+    so = _so_path()
+    if os.path.exists(so) and not force:
+        return so
+    # Drop stale builds of other source versions (incl. any legacy
+    # unversioned fastcsv.so).
+    for old in glob.glob(os.path.join(_HERE, "fastcsv*.so")):
+        if old != so:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", so]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _SO
+        return so
     except Exception:
         return None
 
@@ -46,16 +65,17 @@ def load_fastcsv():
             return None
         try:
             lib = ctypes.CDLL(so)
-            lib.fastcsv_dims.restype = ctypes.c_int
-            lib.fastcsv_dims.argtypes = [
+            lib.fastcsv_scan.restype = ctypes.c_void_p
+            lib.fastcsv_scan.argtypes = [
                 ctypes.c_char_p, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_longlong),
                 ctypes.POINTER(ctypes.c_longlong)]
-            lib.fastcsv_parse.restype = ctypes.c_int
-            lib.fastcsv_parse.argtypes = [
-                ctypes.c_char_p, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_float),
+            lib.fastcsv_extract.restype = ctypes.c_int
+            lib.fastcsv_extract.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
                 ctypes.c_longlong, ctypes.c_longlong]
+            lib.fastcsv_release.restype = None
+            lib.fastcsv_release.argtypes = [ctypes.c_void_p]
             _lib = lib
         except OSError:
             _lib = None

@@ -109,3 +109,26 @@ def test_csv_round_trip_native_and_fallback():
 
     # the native parser should actually be available in this image
     assert load_fastcsv() is not None
+
+
+def test_csv_blank_lines_and_ragged_rows():
+    """Regression: trailing/interior blank lines must not desynchronize row
+    accounting (the old two-call dims/parse API overflowed on a file ending
+    "\\n\\n"); ragged rows clamp to the first data line's column count."""
+    from dist_keras_tpu.data.csv import read_numeric_csv
+
+    cases = {
+        "a,b\n1,2\n3,4\n\n": [[1, 2], [3, 4]],
+        "a,b\n1,2\n3,4\n\n\n": [[1, 2], [3, 4]],
+        "a,b\n1,2\n\n3,4\n5,6\n": [[1, 2], [3, 4], [5, 6]],
+        "a,b\r\n1,2\r\n\r\n3,4\r\n": [[1, 2], [3, 4]],
+        "a,b\n1,2\n   \n3,4\n": [[1, 2], [3, 4]],
+        "a,b\n1,2\n3,4": [[1, 2], [3, 4]],  # no trailing newline
+    }
+    with tempfile.TemporaryDirectory() as d:
+        for i, (content, want) in enumerate(cases.items()):
+            path = os.path.join(d, f"case{i}.csv")
+            with open(path, "w") as f:
+                f.write(content)
+            got, _ = read_numeric_csv(path)
+            assert got.tolist() == want, content
