@@ -90,13 +90,15 @@ class Checkpointer:
         if self._ckpt is not None:
             self._ckpt.save(path, state, force=True)
             self._ckpt.wait_until_finished()
-        else:  # pragma: no cover
+        else:
+            # fallback: pickle the host pytree — symmetric with the
+            # fallback restore below, so a checkpoint written without
+            # orbax is readable anywhere
             os.makedirs(path, exist_ok=True)
-            flat, treedef = jax.tree.flatten(state)
-            np.savez(os.path.join(path, "state.npz"),
-                     treedef=np.frombuffer(
-                         json.dumps(str(treedef)).encode(), dtype=np.uint8),
-                     **{f"l{i}": leaf for i, leaf in enumerate(flat)})
+            import pickle
+
+            with open(os.path.join(path, "state.pkl"), "wb") as f:
+                pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
         self._retain()
 
     def restore(self, step=None, template=None):
@@ -107,12 +109,20 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         path = self._step_dir(step)
+        pkl = os.path.join(path, "state.pkl")
+        if os.path.exists(pkl):  # fallback-format checkpoint
+            import pickle
+
+            with open(pkl, "rb") as f:
+                return step, pickle.load(f)
         if self._ckpt is not None:
             if template is not None:
                 target = jax.tree.map(np.asarray, template)
                 return step, self._ckpt.restore(path, target)
             return step, self._ckpt.restore(path)
-        raise RuntimeError("orbax unavailable")  # pragma: no cover
+        raise RuntimeError(
+            "orbax unavailable and no fallback state.pkl checkpoint at "
+            f"{path}")
 
     def _retain(self):
         steps = self.all_steps()

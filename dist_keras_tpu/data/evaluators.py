@@ -69,9 +69,16 @@ class AUCEvaluator(Evaluator):
             y = np.argmax(y, axis=-1)
         y = (y == self.positive_index).astype(np.int64) \
             if y.max() > 1 else y.astype(np.int64)
-        order = np.argsort(s)
+        order = np.argsort(s, kind="mergesort")
         ranks = np.empty(len(s), dtype=np.float64)
         ranks[order] = np.arange(1, len(s) + 1)
+        # tied scores get their mean rank (Mann-Whitney convention);
+        # arbitrary distinct ranks would bias AUC on quantized/saturated
+        # scores
+        _, inv = np.unique(s, return_inverse=True)
+        sums = np.bincount(inv, weights=ranks)
+        counts = np.bincount(inv)
+        ranks = (sums / counts)[inv]
         n_pos = int(y.sum())
         n_neg = len(y) - n_pos
         if n_pos == 0 or n_neg == 0:

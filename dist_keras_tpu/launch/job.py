@@ -18,8 +18,15 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import shlex
 import subprocess
 import time
+
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9._-]+$")
+# user@host, hostnames, IPv4/IPv6 — must not start with '-' (ssh/rsync
+# would parse it as an option)
+_SAFE_HOST = re.compile(r"^[A-Za-z0-9_\[][A-Za-z0-9._@:\[\]-]*$")
 
 
 class Job:
@@ -39,13 +46,27 @@ class Job:
                  hosts=(), coordinator_port=8476, num_processes=None,
                  remote_root="~/jobs", python="python3", dry_run=False):
         self.secret = secret
+        # job_name becomes a remote path component and Punchcard feeds it
+        # from a JSON manifest — reject anything shell-/path-unsafe
+        if not _SAFE_NAME.match(str(job_name)):
+            raise ValueError(
+                f"job_name {job_name!r} must match [A-Za-z0-9._-]+")
         self.job_name = job_name
         self.job_dir = os.path.abspath(job_dir)
         self.entrypoint = entrypoint
         self.hosts = list(hosts)
+        for h in self.hosts:
+            if not _SAFE_HOST.match(str(h)):
+                raise ValueError(
+                    f"host {h!r} is not a valid ssh destination")
         self.coordinator_port = int(coordinator_port)
         self.num_processes = (int(num_processes) if num_processes
                               else len(self.hosts))
+        # remote_root is interpreted by the remote shell (both rsync and
+        # ssh); restrict to path-safe characters
+        if not re.match(r"^[A-Za-z0-9._/~-]+$", str(remote_root)):
+            raise ValueError(
+                f"remote_root {remote_root!r} must match [A-Za-z0-9._/~-]+")
         self.remote_root = remote_root
         self.python = python
         self.dry_run = dry_run
@@ -78,13 +99,20 @@ class Job:
         coordinator = f"{self.hosts[0]}:{self.coordinator_port}"
         rc = 0
         for pid, host in enumerate(self.hosts):
-            env = (f"JAX_COORDINATOR_ADDRESS={coordinator} "
+            env = (f"JAX_COORDINATOR_ADDRESS={shlex.quote(coordinator)} "
                    f"JAX_NUM_PROCESSES={self.num_processes} "
                    f"JAX_PROCESS_ID={pid}")
+            # every manifest-sourced field is quoted before it reaches the
+            # remote shell (Punchcard manifests are user-editable JSON)
+            # python may be a multi-word command ("python3 -u"): split it,
+            # then quote each word
+            python = " ".join(shlex.quote(w)
+                              for w in shlex.split(self.python))
             rc |= self._run([
                 "ssh", host,
-                f"cd {self._remote_dir()} && {env} nohup {self.python} "
-                f"{self.entrypoint} > job.log 2>&1 &"])
+                f"cd {self._remote_dir()} && {env} nohup "
+                f"{python} {shlex.quote(self.entrypoint)} "
+                f"> job.log 2>&1 &"])
         return rc
 
     def send(self):

@@ -8,9 +8,15 @@ exposes the model as a pure function of its variable lists — exactly the
 ``apply(params, x)`` contract every trainer here consumes — so arbitrary
 Keras architectures train on the TPU mesh unchanged.
 
-Limitations (round 1): non-trainable variables (BatchNorm moving stats,
-seed generators) are captured at wrap time and held constant during
-training — fine for the reference's model families, which have none.
+State handling: the adapter's ``params`` pytree is
+``{"trainable": [...], "state": [...]}`` where ``state`` carries the
+model's ``non_trainable_variables`` (BatchNorm moving stats, dropout seed
+generators).  ``stateless_call`` returns the updated non-trainables each
+batch; the trainers' aux-state channel (trainers/step.py
+``make_model_step``) folds them back into the carried params, so moving
+statistics advance and seeded random layers reseed exactly as
+``keras_model.fit`` would.  Gradients and the optimizer only ever touch the
+``trainable`` split.
 """
 
 from __future__ import annotations
@@ -44,23 +50,69 @@ class KerasModelAdapter:
             raise ValueError("build the Keras model first (call it once "
                              "or specify an Input layer)")
         self._model = keras_model
-        self.params = [jnp.asarray(np.asarray(v))
-                       for v in keras_model.trainable_variables]
-        self._non_trainable = [jnp.asarray(np.asarray(v))
-                               for v in keras_model.non_trainable_variables]
+        self.params = {
+            "trainable": [jnp.asarray(np.asarray(v))
+                          for v in keras_model.trainable_variables],
+            "state": [jnp.asarray(np.asarray(v))
+                      for v in keras_model.non_trainable_variables],
+        }
         self.name = keras_model.name
 
     # ---- trainer contract -------------------------------------------
     def apply(self, params, x, *, training=False, rng=None):
+        import jax
+
         outputs, _ = self._model.stateless_call(
-            params, self._non_trainable, x, training=training)
+            params["trainable"], jax.lax.stop_gradient(params["state"]), x,
+            training=training)
         return outputs
+
+    def apply_with_state(self, params, x, *, training=False, rng=None):
+        """(y, new_state) — ``stateless_call`` hands back the updated
+        non-trainables (moving stats already momentum-blended by the Keras
+        layer, seed generators advanced); they replace the state split."""
+        import jax
+
+        outputs, new_state = self._model.stateless_call(
+            params["trainable"], jax.lax.stop_gradient(params["state"]), x,
+            training=training)
+        return outputs, jax.lax.stop_gradient(list(new_state))
+
+    def has_state(self):
+        return len(self.params["state"]) > 0
+
+    def split_state(self, params):
+        return params["trainable"], params["state"]
+
+    def join_state(self, trainable, state):
+        return {"trainable": trainable, "state": state}
+
+    def cast_params(self, params, dtype):
+        """Compute-dtype cast for the trainable split only; state stays at
+        its native dtype (seed generators are integer, moving-stat blends
+        need f32 resolution)."""
+        from dist_keras_tpu.utils.pytree import tree_cast
+
+        return {"trainable": tree_cast(params["trainable"], dtype),
+                "state": params["state"]}
 
     def set_params(self, params):
         import jax.numpy as jnp
 
-        self.params = [jnp.asarray(np.asarray(p)) for p in params]
-        for var, val in zip(self._model.trainable_variables, self.params):
+        if not isinstance(params, dict):  # flat trainables (legacy callers)
+            params = {"trainable": list(params),
+                      "state": self.params["state"]}
+        self.params = {
+            "trainable": [jnp.asarray(np.asarray(p))
+                          for p in params["trainable"]],
+            "state": [jnp.asarray(np.asarray(s))
+                      for s in params["state"]],
+        }
+        for var, val in zip(self._model.trainable_variables,
+                            self.params["trainable"]):
+            var.assign(np.asarray(val))
+        for var, val in zip(self._model.non_trainable_variables,
+                            self.params["state"]):
             var.assign(np.asarray(val))
 
     # ---- serialization contract (utils.py:~40 dict shape) ------------
@@ -68,10 +120,25 @@ class KerasModelAdapter:
         return self._model.to_json()
 
     def get_weights(self):
-        return [np.asarray(p) for p in self.params]
+        """Flat list: trainables then non-trainables (round-trips through
+        ``set_weights``; counts come from the model's variable lists)."""
+        return ([np.asarray(p) for p in self.params["trainable"]]
+                + [np.asarray(s) for s in self.params["state"]])
 
     def set_weights(self, weights):
-        self.set_params(list(weights))
+        weights = list(weights)
+        n_t = len(self._model.trainable_variables)
+        n_s = len(self._model.non_trainable_variables)
+        if len(weights) == n_t:  # trainables only (older serialized form)
+            self.set_params({"trainable": weights,
+                             "state": self.params["state"]})
+        elif len(weights) == n_t + n_s:
+            self.set_params({"trainable": weights[:n_t],
+                             "state": weights[n_t:]})
+        else:
+            raise ValueError(
+                f"got {len(weights)} weights; model has {n_t} trainable "
+                f"+ {n_s} non-trainable variables")
 
     def __call__(self, x, *, training=False, rng=None):
         return self.apply(self.params, x, training=training, rng=rng)

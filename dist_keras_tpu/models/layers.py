@@ -100,6 +100,18 @@ class Layer:
     def apply(self, params, x, *, training=False, rng=None):
         return x
 
+    def apply_with_state(self, params, x, *, training=False, rng=None):
+        """-> (y, state_update).  ``state_update`` maps the layer's state
+        leaves (see ``state_names``) to their post-batch values; stateless
+        layers return an empty dict.  This is the aux-state channel the
+        trainers thread through their scans (see trainers/step.py)."""
+        return self.apply(params, x, training=training, rng=rng), {}
+
+    # ---- state leaves (non-trainable, updated via the aux channel) ----
+    def state_names(self):
+        """Parameter names that are running state, not trainable weights."""
+        return ()
+
     # ---- config round-trip (Keras `get_config` / `from_config` parity) ----
     def get_config(self):
         return {}
@@ -237,8 +249,15 @@ class AvgPool2D(_Pool2D):
     def apply(self, params, x, *, training=False, rng=None):
         self._reducer = lax.add
         self._init_val = 0.0
+        summed = self._pool(x)
         ph, pw = self.pool_size
-        return self._pool(x) / (ph * pw)
+        if self.padding.upper() == "VALID":
+            return summed / (ph * pw)
+        # 'same': Keras/TF average pooling divides by the number of VALID
+        # (non-padded) positions in each window, not the full window size —
+        # pool an all-ones tensor to get that count per output position.
+        counts = self._pool(jnp.ones_like(x))
+        return summed / counts
 
 
 @register_layer
@@ -323,9 +342,13 @@ class BatchNorm(Layer):
     """Batch normalisation.
 
     Functional twist: running statistics are *parameters* (leaves named
-    ``moving_mean``/``moving_var``) updated by the trainer via the aux-state
-    channel, not hidden layer state.  In training mode the layer normalises
-    with batch statistics; in inference mode with the stored moving stats.
+    ``moving_mean``/``moving_var``, flagged by ``state_names``) updated by
+    the trainers through the aux-state channel: ``apply_with_state`` returns
+    the momentum-blended stats each training batch and the step machinery
+    folds them back into the params pytree (the optimizer never touches
+    them — see ``split_state`` in models/model.py).  In training mode the
+    layer normalises with batch statistics; in inference mode with the
+    stored moving stats — matching Keras ``BatchNormalization``.
     """
 
     def __init__(self, momentum=0.99, epsilon=1e-3):
@@ -341,21 +364,47 @@ class BatchNorm(Layer):
             "moving_var": jnp.ones((dim,), jnp.float32),
         }, in_shape
 
-    def apply(self, params, x, *, training=False, rng=None):
+    def _stats(self, params, x, training):
         axes = tuple(range(x.ndim - 1))
         if training:
-            mu = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
-        else:
-            mu, var = params["moving_mean"], params["moving_var"]
-        y = (x - mu.astype(x.dtype)) * lax.rsqrt(var.astype(x.dtype) + self.epsilon)
-        return y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+            return jnp.mean(x, axis=axes), jnp.var(x, axis=axes)
+        return params["moving_mean"], params["moving_var"]
+
+    def _norm(self, params, x, mu, var):
+        y = (x - mu.astype(x.dtype)) * lax.rsqrt(
+            var.astype(x.dtype) + self.epsilon)
+        return (y * params["gamma"].astype(x.dtype)
+                + params["beta"].astype(x.dtype))
+
+    def apply(self, params, x, *, training=False, rng=None):
+        mu, var = self._stats(params, x, training)
+        return self._norm(params, x, mu, var)
+
+    def apply_with_state(self, params, x, *, training=False, rng=None):
+        mu, var = self._stats(params, x, training)
+        y = self._norm(params, x, mu, var)
+        if not training:
+            return y, {}
+        # Blend in f32 regardless of the compute dtype: with momentum 0.99
+        # the per-batch increment is below bf16 resolution and would be
+        # rounded away.  The stored moving stats are never cast (state
+        # leaves are exempt from the compute-dtype policy).
+        m = self.momentum
+        new_mean = (m * params["moving_mean"].astype(jnp.float32)
+                    + (1.0 - m) * mu.astype(jnp.float32))
+        new_var = (m * params["moving_var"].astype(jnp.float32)
+                   + (1.0 - m) * var.astype(jnp.float32))
+        return y, {"moving_mean": jax.lax.stop_gradient(new_mean),
+                   "moving_var": jax.lax.stop_gradient(new_var)}
 
     def get_config(self):
         return {"momentum": self.momentum, "epsilon": self.epsilon}
 
     def weight_names(self):
         return ["gamma", "beta", "moving_mean", "moving_var"]
+
+    def state_names(self):
+        return ("moving_mean", "moving_var")
 
 
 @register_layer

@@ -69,6 +69,48 @@ class Sequential:
             x = layer.apply(p, x, training=training, rng=r)
         return x
 
+    def apply_with_state(self, params, x, *, training=False, rng=None):
+        """Forward pass returning ``(y, states)`` where ``states`` is a
+        per-layer list of state-leaf updates (empty dicts for stateless
+        layers) — the aux-state channel consumed by trainers/step.py so
+        BatchNorm moving statistics actually advance during training."""
+        if rng is not None:
+            rngs = jax.random.split(rng, max(len(self.layers), 1))
+        states = []
+        for i, (layer, p) in enumerate(zip(self.layers, params)):
+            r = rngs[i] if rng is not None else None
+            x, s = layer.apply_with_state(p, x, training=training, rng=r)
+            states.append(s)
+        return x, states
+
+    # ------------------------------------------------------------------
+    # aux-state channel (BatchNorm moving stats & co.)
+    # ------------------------------------------------------------------
+    def has_state(self):
+        return any(layer.state_names() for layer in self.layers)
+
+    def split_state(self, params):
+        """params -> (trainable, state): two parallel per-layer dict lists.
+        The optimizer only ever sees ``trainable``; ``state`` is advanced by
+        ``apply_with_state`` and rejoined with ``join_state``."""
+        trainable, state = [], []
+        for layer, p in zip(self.layers, params):
+            names = set(layer.state_names())
+            trainable.append({k: v for k, v in p.items() if k not in names})
+            state.append({k: v for k, v in p.items() if k in names})
+        return trainable, state
+
+    def join_state(self, trainable, state):
+        return [{**t, **s} for t, s in zip(trainable, state)]
+
+    def cast_params(self, params, dtype):
+        """Compute-dtype cast that leaves state leaves (moving stats) in
+        f32 — their momentum blend needs more resolution than bf16."""
+        from dist_keras_tpu.utils.pytree import tree_cast
+
+        trainable, state = self.split_state(params)
+        return self.join_state(tree_cast(trainable, dtype), state)
+
     def __call__(self, x, *, training=False, rng=None):
         self._require_built()
         return self.apply(self.params, jnp.asarray(x), training=training, rng=rng)

@@ -31,6 +31,25 @@ def tree_pmean(tree, axis=WORKER_AXIS):
     return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
 
 
+def tree_pmean_sync(tree, axis=WORKER_AXIS):
+    """Average floating leaves across the axis; ``pmax`` the rest.
+
+    The merge algebra only makes sense for float weights.  Integer leaves
+    (Keras seed-generator counters riding in a stateful model's params)
+    advance in lockstep on every worker, so ``pmax`` returns their common
+    value — and, unlike keeping the local copy, the result is typed
+    axis-invariant, which scan carries declared replicated require.
+    """
+    import jax.numpy as jnp
+
+    def _merge(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return lax.pmean(x, axis)
+        return lax.pmax(x, axis)
+
+    return jax.tree.map(_merge, tree)
+
+
 def tree_all_gather(tree, axis=WORKER_AXIS):
     return jax.tree.map(lambda x: lax.all_gather(x, axis), tree)
 

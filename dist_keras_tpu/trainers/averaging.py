@@ -17,10 +17,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from dist_keras_tpu.parallel.collectives import tree_pmean, tree_pvary
+from dist_keras_tpu.parallel.collectives import tree_pmean_sync, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.trainers.base import DistributedTrainer
-from dist_keras_tpu.trainers.step import make_sgd_step
+from dist_keras_tpu.trainers.step import make_model_step
 
 try:
     from jax import shard_map
@@ -42,8 +42,8 @@ class AveragingTrainer(DistributedTrainer):
         num_epoch = self.num_epoch
 
         def build():
-            step = make_sgd_step(
-                model.apply, loss_fn, tx, self.compute_dtype)
+            step, opt_init = make_model_step(
+                model, loss_fn, tx, self.compute_dtype)
 
             def body(params, xs, ys, rng):
                 xs, ys = xs[0], ys[0]  # shard -> local (steps, batch, ...)
@@ -58,10 +58,13 @@ class AveragingTrainer(DistributedTrainer):
                     local = tree_pvary(params)
                     # Fresh worker optimizer each epoch, as the reference
                     # recompiles the model per epoch (trainers.py:~170).
-                    opt_state = tx.init(local)
+                    opt_state = opt_init(local)
                     (local, _, rng), losses = jax.lax.scan(
                         step, (local, opt_state, rng), (xs, ys))
-                    params = tree_pmean(local)
+                    # pmean float weights; pmax integer leaves (lockstep
+                    # seed counters) back to an axis-invariant type for
+                    # the replicated epoch carry
+                    params = tree_pmean_sync(local)
                     return (params, rng), losses
 
                 (params, _), losses = jax.lax.scan(
@@ -108,15 +111,15 @@ class EnsembleTrainer(DistributedTrainer):
         num_epoch = self.num_epoch
 
         def build():
-            step = make_sgd_step(
-                model.apply, loss_fn, tx, self.compute_dtype)
+            step, opt_init = make_model_step(
+                model, loss_fn, tx, self.compute_dtype)
 
             def body(params, xs, ys, rng):
                 xs, ys = xs[0], ys[0]
                 rng = jax.random.fold_in(
                     rng, jax.lax.axis_index(WORKER_AXIS))
                 params = tree_pvary(params)  # independent replicas
-                opt_state = tx.init(params)
+                opt_state = opt_init(params)
 
                 def epoch(carry, _):
                     params, opt_state, rng = carry

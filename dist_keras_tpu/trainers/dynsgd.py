@@ -31,7 +31,8 @@ from jax.sharding import PartitionSpec as P
 from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.trainers.base import DistributedTrainer
-from dist_keras_tpu.trainers.step import make_sgd_step
+from dist_keras_tpu.trainers.step import make_model_step
+from dist_keras_tpu.utils.pytree import tree_merge_floats, tree_zeros_like
 
 try:
     from jax import shard_map
@@ -39,7 +40,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _make_body(step, tx, window, num_workers, num_epoch):
+def _make_body(step, opt_init, window, num_workers, num_epoch):
     def body(params, xs, ys, key):
         xs, ys = xs[0], ys[0]
         widx = jax.lax.axis_index(WORKER_AXIS)
@@ -51,7 +52,7 @@ def _make_body(step, tx, window, num_workers, num_epoch):
         # required so local gradients stay local).
         pulled = tree_pvary(params)
         local = tree_pvary(params)
-        opt_state = tree_pvary(tx.init(params))
+        opt_state = tree_pvary(opt_init(params))
         last_seen = tree_pvary(jnp.zeros((), jnp.int32))
         global_count = jnp.zeros((), jnp.int32)
 
@@ -66,17 +67,27 @@ def _make_body(step, tx, window, num_workers, num_epoch):
             m = commit.astype(jnp.float32)
             staleness = (global_count - last_seen).astype(jnp.float32)
             scale = m / (staleness + 1.0)
-            contribution = jax.tree.map(
-                lambda l, p: scale * (l - p), local, pulled)
+
+            # integer leaves (Keras seed-generator counters) are RNG
+            # state, not weights: zero contribution, never pulled
+            # (tree_merge_floats implements the exemption policy)
+            contribution = tree_merge_floats(
+                jax.tree.map(lambda l, p: scale * (l.astype(jnp.float32)
+                                                   - p.astype(jnp.float32)),
+                             local, pulled),
+                tree_zeros_like(local))
             center = jax.tree.map(
-                lambda c, d: c + d, center, tree_psum(contribution))
+                lambda c, d: (c + d).astype(c.dtype), center,
+                tree_psum(contribution))
             global_count = global_count + jax.lax.psum(
                 commit.astype(jnp.int32), WORKER_AXIS)
             # committing workers pull the fresh center
-            local = jax.tree.map(
-                lambda l, c: jnp.where(commit, c, l), local, center)
-            pulled = jax.tree.map(
-                lambda p, c: jnp.where(commit, c, p), pulled, center)
+            local = tree_merge_floats(
+                jax.tree.map(lambda l, c: jnp.where(commit, c, l),
+                             local, center), local)
+            pulled = tree_merge_floats(
+                jax.tree.map(lambda p, c: jnp.where(commit, c, p),
+                             pulled, center), pulled)
             last_seen = jnp.where(commit, global_count, last_seen)
             return (center, pulled, local, opt_state, rng,
                     last_seen, global_count), loss
@@ -122,10 +133,10 @@ class DynSGD(DistributedTrainer):
         mesh = self.mesh
 
         def build():
-            step = make_sgd_step(
-                model.apply, loss_fn, tx, self.compute_dtype)
+            step, opt_init = make_model_step(
+                model, loss_fn, tx, self.compute_dtype)
             return jax.jit(shard_map(
-                _make_body(step, tx, self.communication_window,
+                _make_body(step, opt_init, self.communication_window,
                            self.num_workers, self.num_epoch),
                 mesh=mesh,
                 in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dist_keras_tpu.trainers.base import Trainer
-from dist_keras_tpu.trainers.step import make_sgd_step, scan_epoch
+from dist_keras_tpu.trainers.step import make_model_step, scan_epoch
 
 
 class SingleTrainer(Trainer):
@@ -24,14 +24,13 @@ class SingleTrainer(Trainer):
         xb, yb = dataset.batches(
             self.batch_size, self.features_col, self.label_col)
 
+        step, opt_init = make_model_step(
+            model, loss_fn, tx, self.compute_dtype)
         params = model.params
-        opt_state = tx.init(params)
+        opt_state = opt_init(params)
         rng = jax.random.PRNGKey(self.seed)
 
         def build():
-            step = make_sgd_step(
-                model.apply, loss_fn, tx, self.compute_dtype)
-
             @jax.jit
             def run_epoch(params, opt_state, rng, xb, yb):
                 return scan_epoch(step, params, opt_state, rng, xb, yb)

@@ -54,6 +54,66 @@ def make_sgd_step(apply_fn, loss_fn, tx, compute_dtype=None, training=True):
     return step
 
 
+def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
+    """-> (step, opt_init) for a model object.
+
+    For stateless models this is exactly ``make_sgd_step(model.apply, ...)``
+    with ``opt_init = tx.init``.  For models with running state (BatchNorm
+    moving stats, Keras seed generators — anything ``model.has_state()``
+    reports), the step threads the aux-state channel:
+
+    - gradients are taken w.r.t. the *trainable* split only, so integer
+      state leaves (Keras seed generators) never hit ``jax.grad`` and the
+      optimizer never decays moving statistics;
+    - the state split is replaced each step by the values
+      ``model.apply_with_state`` returns (momentum-blended batch stats,
+      advanced seed state);
+    - ``opt_init(params)`` builds optimizer state over the trainable split
+      only — trainers must use it instead of raw ``tx.init``.
+
+    The carried params pytree keeps its full structure (state leaves
+    included), so trainer merge algebra (psum deltas, elastic averaging,
+    pmean) treats moving stats like any other weight — the reference
+    behaves identically, since Keras ``get_weights`` includes them.
+    """
+    has_state = getattr(model, "has_state", None)
+    if has_state is None or not model.has_state():
+        step = make_sgd_step(model.apply, loss_fn, tx, compute_dtype,
+                             training)
+        return step, tx.init
+
+    cast = getattr(model, "cast_params", None) or (
+        lambda p, d: tree_cast(p, d))
+
+    def loss_of(trainable, state, x, y, rng=None):
+        params = model.join_state(trainable, state)
+        if compute_dtype is not None:
+            params = cast(params, compute_dtype)
+            x = x.astype(compute_dtype)
+        preds, new_state = model.apply_with_state(
+            params, x, training=training, rng=rng)
+        loss = loss_fn(preds.astype(jnp.float32), y.astype(jnp.float32))
+        return loss, new_state
+
+    grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+
+    def step(carry, batch):
+        params, opt_state, rng = carry
+        x, y = batch
+        rng, sub = jax.random.split(rng)
+        trainable, state = model.split_state(params)
+        (loss, new_state), grads = grad_fn(trainable, state, x, y, sub)
+        updates, opt_state = tx.update(grads, opt_state, trainable)
+        trainable = optax.apply_updates(trainable, updates)
+        params = model.join_state(trainable, new_state)
+        return (params, opt_state, rng), loss
+
+    def opt_init(params):
+        return tx.init(model.split_state(params)[0])
+
+    return step, opt_init
+
+
 def scan_epoch(step, params, opt_state, rng, xb, yb):
     """Run ``step`` over every batch with lax.scan.
 

@@ -40,8 +40,13 @@ from jax.sharding import PartitionSpec as P
 from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.trainers.base import DistributedTrainer
-from dist_keras_tpu.trainers.step import make_sgd_step
-from dist_keras_tpu.utils.pytree import tree_add, tree_scale, tree_sub
+from dist_keras_tpu.trainers.step import make_model_step
+from dist_keras_tpu.utils.pytree import (
+    tree_add,
+    tree_merge_floats,
+    tree_scale,
+    tree_sub,
+)
 
 try:
     from jax import shard_map
@@ -52,10 +57,11 @@ except ImportError:  # older jax
 class AsynchronousDistributedTrainer(DistributedTrainer):
     """Base of the windowed family (trainers.py:~420).
 
-    ``parallelism_factor`` (trainers.py:~310) is accepted for parity: the
-    reference oversubscribes partitions; here extra shards would be folded
-    into each worker's step axis, which ``worker_shards`` already does by
-    dealing all rows across workers.
+    ``parallelism_factor`` (trainers.py:~310) is accepted for parity but is
+    a deliberate no-op: the reference oversubscribes Spark partitions so a
+    straggling executor can be load-balanced, a failure mode lockstep SPMD
+    does not have — every worker is one mesh slot and ``worker_shards``
+    already deals all rows evenly across workers.
     """
 
     def __init__(self, keras_model, num_workers=2, communication_window=5,
@@ -67,8 +73,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     def _cache_extras(self):
         # num_epoch is the outer scan length -> part of the trace
         return super()._cache_extras() + (
-            self.communication_window, self.parallelism_factor,
-            self.num_epoch)
+            self.communication_window, self.num_epoch)
 
     # --- strategy hooks -------------------------------------------------
     def wrap_optimizer(self, tx):
@@ -117,8 +122,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         num_epoch = self.num_epoch
 
         def build():
-            step = make_sgd_step(
-                model.apply, loss_fn, tx, self.compute_dtype)
+            step, opt_init = make_model_step(
+                model, loss_fn, tx, self.compute_dtype)
 
             def body(params, xs, ys, key):
                 xs, ys = xs[0], ys[0]  # (windows, W, batch, ...)
@@ -127,14 +132,18 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 # Local replica state must be explicitly worker-varying or
                 # the backward pass silently psums gradients (tree_pvary).
                 local = tree_pvary(params)
-                opt_state = tx.init(local)
+                opt_state = opt_init(local)
 
                 def window(carry, batch):
                     center, local, opt_state, rng = carry
                     xw, yw = batch
                     (local, opt_state, rng), losses = jax.lax.scan(
                         step, (local, opt_state, rng), (xw, yw))
-                    center, local = merge(center, local)
+                    new_center, new_local = merge(center, local)
+                    # integer leaves (Keras seed-generator counters) are
+                    # RNG state, not weights: exempt from merge algebra
+                    center = tree_merge_floats(new_center, center)
+                    local = tree_merge_floats(new_local, local)
                     # merges that reset local to the (replicated) center
                     # must hand back a varying-typed local for next window
                     local = tree_pvary(local)

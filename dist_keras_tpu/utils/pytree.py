@@ -53,6 +53,20 @@ def tree_global_norm(a):
     return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
 
 
+def tree_merge_floats(merged, original):
+    """Take ``merged`` for floating leaves and ``original`` for the rest.
+
+    Weight-merge algebra (deltas, psums, elastic averaging) only makes
+    sense for floating parameters; integer leaves — e.g. Keras seed
+    generator counters carried in an adapter's state split — must pass
+    through untouched or scaling promotes them to float and breaks scan
+    carry dtypes.
+    """
+    return jax.tree.map(
+        lambda m, o: m if jnp.issubdtype(o.dtype, jnp.floating) else o,
+        merged, original)
+
+
 def tree_cast(a, dtype):
     """Cast floating leaves to ``dtype`` (used for bf16 compute policies)."""
     def _cast(x):

@@ -127,3 +127,50 @@ def test_punchcard_secret_auth(tmp_path):
     assert [j.job_name for j in ran] == ["a"]
     # idempotent: second poll doesn't rerun
     assert pc.run_once() == []
+
+
+def test_checkpointer_npz_fallback_round_trip(tmp_path, monkeypatch):
+    """A checkpoint written without orbax must be readable (the old
+    fallback could save but raised on restore)."""
+    import dist_keras_tpu.checkpoint as ck
+
+    monkeypatch.setattr(ck, "_HAVE_ORBAX", False)
+    c = ck.Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    assert c._ckpt is None
+    state = {"params": [np.arange(4, dtype=np.float32)], "epoch": 3}
+    c.save(7, state)
+    step, restored = c.restore()
+    assert step == 7
+    assert restored["epoch"] == 3
+    np.testing.assert_array_equal(restored["params"][0], state["params"][0])
+
+
+def test_auc_tie_handling_mean_ranks():
+    """Tied scores take their mean rank; compare against sklearn."""
+    from sklearn.metrics import roc_auc_score
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.data.evaluators import AUCEvaluator
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 200)
+    # heavily quantized scores -> many ties
+    s = np.round(rng.random(200) * 4) / 4 + 0.1 * y
+    s = np.clip(s, 0, 1)
+    ds = Dataset({"prediction": s, "label": y})
+    ours = AUCEvaluator(score_col="prediction").evaluate(ds)
+    ref = roc_auc_score(y, s)
+    assert abs(ours - ref) < 1e-9, (ours, ref)
+
+
+def test_job_rejects_unsafe_names(tmp_path):
+    from dist_keras_tpu.launch.job import Job
+
+    with pytest.raises(ValueError):
+        Job("s", "bad;rm -rf /", str(tmp_path), hosts=["h"], dry_run=True)
+    with pytest.raises(ValueError):
+        Job("s", "ok", str(tmp_path), hosts=["h"], dry_run=True,
+            remote_root="~/jobs;evil")
+    job = Job("s", "ok-name_1", str(tmp_path), hosts=["h"], dry_run=True)
+    job.send()
+    assert any("rsync" == c[0] for c in job.commands)

@@ -53,3 +53,105 @@ def test_keras_model_trains(blobs_dataset):
     logits = trained.predict(np.asarray(blobs_dataset["features"]))
     acc = float(np.mean(np.argmax(logits, -1) == blobs_dataset["label"]))
     assert acc > 0.9
+
+
+def test_keras_batchnorm_state_updates_and_matches_fit(blobs_dataset):
+    """A Keras-3 BatchNorm model must train with advancing moving stats;
+    one SGD step through our trainer matches keras-native train_on_batch."""
+    x = np.asarray(blobs_dataset["features"])[:64]
+    y = np.asarray(blobs_dataset["label_encoded"])[:64]
+
+    def build():
+        keras.utils.set_random_seed(0)
+        return keras.Sequential([
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.Dense(2),
+        ])
+
+    # ours: one epoch of one full-batch step, plain SGD
+    km_ours = build()
+    ad = KerasModelAdapter(km_ours)
+    init_nt = [np.asarray(v) for v in ad.params["state"]]
+    t = SingleTrainer(ad, loss="categorical_crossentropy",
+                      worker_optimizer="sgd",
+                      optimizer_kwargs={"learning_rate": 0.05},
+                      batch_size=64, num_epoch=1, label_col="label_encoded")
+    trained = t.train(
+        type(blobs_dataset)({"features": x, "label_encoded": y}))
+
+    new_nt = [np.asarray(v) for v in trained.params["state"]]
+    moved = any(not np.allclose(a, b) for a, b in zip(init_nt, new_nt))
+    assert moved, "Keras non-trainables (moving stats) never updated"
+
+    # keras-native: same model, same init, one train_on_batch
+    km_ref = build()
+    km_ref.compile(
+        optimizer=keras.optimizers.SGD(learning_rate=0.05),
+        loss=keras.losses.CategoricalCrossentropy(from_logits=True))
+    km_ref.train_on_batch(x, y)
+
+    for ours, ref in zip(trained.get_weights(),
+                         (km_ref.trainable_variables
+                          + km_ref.non_trainable_variables)):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(ref), atol=2e-4,
+            err_msg="one-step SGD mismatch vs keras train_on_batch")
+
+
+def test_keras_dropout_seed_state_trains(blobs_dataset):
+    """Dropout carries integer seed-generator state: it must thread through
+    the state channel (grads only on floats) and survive the windowed
+    trainers' merge algebra."""
+    from dist_keras_tpu.trainers import ADAG
+
+    keras.utils.set_random_seed(1)
+    km = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dropout(0.3),
+        keras.layers.Dense(2),
+    ])
+    ad = KerasModelAdapter(km)
+    t = ADAG(ad, num_workers=4, communication_window=2,
+             worker_optimizer="adam", loss="categorical_crossentropy",
+             batch_size=16, num_epoch=8, label_col="label_encoded")
+    trained = t.train(blobs_dataset)
+    hist = np.asarray(t.get_history())
+    assert np.isfinite(hist).all()
+    logits = trained.predict(np.asarray(blobs_dataset["features"]))
+    acc = float(np.mean(np.argmax(logits, -1) == blobs_dataset["label"]))
+    assert acc > 0.9
+
+
+def test_keras_dropout_averaging_and_dynsgd(blobs_dataset):
+    """Integer seed-state leaves must survive every merge algebra: the
+    epoch-pmean (AveragingTrainer) and the staggered masked-psum commits
+    (DynSGD), not just the windowed family."""
+    from dist_keras_tpu.trainers import AveragingTrainer, DynSGD
+
+    def build():
+        keras.utils.set_random_seed(1)
+        return keras.Sequential([
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dropout(0.3),
+            keras.layers.Dense(2),
+        ])
+
+    for ctor in (
+        lambda m: AveragingTrainer(m, num_workers=4,
+            worker_optimizer="adam", loss="categorical_crossentropy",
+            batch_size=16, num_epoch=10, label_col="label_encoded"),
+        lambda m: DynSGD(m, num_workers=4, communication_window=2,
+            worker_optimizer="adam", loss="categorical_crossentropy",
+            batch_size=16, num_epoch=4, label_col="label_encoded"),
+    ):
+        t = ctor(KerasModelAdapter(build()))
+        trained = t.train(blobs_dataset)
+        assert np.isfinite(np.asarray(t.get_history())).all()
+        logits = trained.predict(np.asarray(blobs_dataset["features"]))
+        acc = float(np.mean(
+            np.argmax(logits, -1) == blobs_dataset["label"]))
+        assert acc > 0.85, type(t).__name__

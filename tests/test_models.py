@@ -77,3 +77,50 @@ def test_pooling():
     m = Sequential([Conv2D(4, 3, padding="same"), MaxPool2D(2), Flatten()])
     m.build((8, 8, 1))
     assert m.output_shape == (4 * 4 * 4,)
+
+
+def test_avgpool_same_padding_excludes_pad():
+    """Keras/TF 'same' average pooling divides by the count of valid
+    positions, not the full window — edge outputs must not be scaled down."""
+    from dist_keras_tpu.models import AvgPool2D
+
+    x = np.ones((1, 3, 3, 1), np.float32)
+    pool = AvgPool2D(pool_size=2, strides=2, padding="same")
+    out = np.asarray(pool.apply({}, x))
+    # every window averages only real (all-ones) elements -> exactly 1.0
+    np.testing.assert_allclose(out, np.ones_like(out), atol=1e-6)
+
+    pool_valid = AvgPool2D(pool_size=2, strides=1, padding="valid")
+    out_v = np.asarray(pool_valid.apply({}, x))
+    np.testing.assert_allclose(out_v, np.ones_like(out_v), atol=1e-6)
+
+
+def test_batchnorm_state_channel_blend():
+    """apply_with_state returns momentum-blended moving stats in training
+    mode and nothing in eval mode."""
+    bn = BatchNorm(momentum=0.9)
+    params, _ = bn.init(jax.random.PRNGKey(0), (4,))
+    x = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32) * 3 + 2
+
+    y, state = bn.apply_with_state(params, x, training=True)
+    mu, var = x.mean(0), x.var(0)
+    np.testing.assert_allclose(
+        np.asarray(state["moving_mean"]), 0.9 * 0.0 + 0.1 * mu, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state["moving_var"]), 0.9 * 1.0 + 0.1 * var, rtol=1e-5)
+
+    _, state_eval = bn.apply_with_state(params, x, training=False)
+    assert state_eval == {}
+
+
+def test_sequential_split_join_state():
+    m = Sequential([Dense(8), BatchNorm(), Dense(2)])
+    m.build((4,))
+    assert m.has_state()
+    t, s = m.split_state(m.params)
+    assert set(s[1]) == {"moving_mean", "moving_var"}
+    assert set(t[1]) == {"gamma", "beta"}
+    assert s[0] == {} and s[2] == {}
+    rejoined = m.join_state(t, s)
+    for a, b in zip(jax.tree.leaves(rejoined), jax.tree.leaves(m.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
