@@ -29,29 +29,25 @@ except ImportError:  # older jax
 
 
 class AveragingTrainer(DistributedTrainer):
-    def _cache_extras(self):
-        # the epoch count is the outer scan length -> part of the trace
-        return super()._cache_extras() + (self.num_epoch,)
-
     def train(self, dataset, shuffle=False):
+        import time as _time
+
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
         mesh = self.mesh
-        num_epoch = self.num_epoch
+        step, opt_init = make_model_step(
+            model, loss_fn, tx, self.compute_dtype)
 
-        def build():
-            step, opt_init = make_model_step(
-                model, loss_fn, tx, self.compute_dtype)
-
-            def body(params, xs, ys, rng):
+        def build_chunk(E):
+            def body(params, xs, ys, key, epoch0):
                 xs, ys = xs[0], ys[0]  # shard -> local (steps, batch, ...)
-                rng = jax.random.fold_in(
-                    rng, jax.lax.axis_index(WORKER_AXIS))
+                widx = jax.lax.axis_index(WORKER_AXIS)
 
-                def epoch(carry, _):
-                    params, rng = carry
+                def epoch(params, e):
+                    rng = jax.random.fold_in(
+                        jax.random.fold_in(key, e), widx)
                     # Local copies must be explicitly worker-varying, else
                     # the backward pass psums gradients globally (see
                     # tree_pvary).
@@ -59,34 +55,56 @@ class AveragingTrainer(DistributedTrainer):
                     # Fresh worker optimizer each epoch, as the reference
                     # recompiles the model per epoch (trainers.py:~170).
                     opt_state = opt_init(local)
-                    (local, _, rng), losses = jax.lax.scan(
-                        step, (local, opt_state, rng), (xs, ys))
+                    (local, _, _), losses = jax.lax.scan(
+                        step, (local, opt_state, tree_pvary(rng)),
+                        (xs, ys))
                     # pmean float weights; pmax integer leaves (lockstep
                     # seed counters) back to an axis-invariant type for
                     # the replicated epoch carry
                     params = tree_pmean_sync(local)
-                    return (params, rng), losses
+                    return params, losses
 
-                (params, _), losses = jax.lax.scan(
-                    epoch, (params, rng), None, length=num_epoch)
-                return params, losses[None]  # losses: (1, epochs, steps)
+                params, losses = jax.lax.scan(
+                    epoch, params, jnp.arange(E) + epoch0)
+                return params, losses[None]  # losses: (1, E, steps)
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
                 out_specs=(P(), P(WORKER_AXIS)),
             ))
 
-        fn = self._compiled(build)
+        params = model.params
+        start_epoch, restored = self._maybe_resume({"params": params})
+        if restored is not None:
+            params = restored["params"]
+
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        key = jax.random.PRNGKey(self.seed)
+        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
         self.record_training_start()
-        params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
-                            jax.random.PRNGKey(self.seed))
-        jax.block_until_ready(params)
+        all_losses = []
+        epochs_done = start_epoch
+        for E in self._chunk_plan(start_epoch):
+            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
+            t0 = _time.time()
+            params, losses = fn(params, xs, ys, key, jnp.int32(epochs_done))
+            jax.block_until_ready(params)
+            dt = _time.time() - t0
+            epochs_done += E
+            losses = np.asarray(losses)  # (workers, E, steps)
+            all_losses.append(losses)
+            self._emit_epoch_end(epochs_done, losses, dt,
+                                 samples_per_epoch * E)
+            self._maybe_checkpoint(epochs_done, lambda: {"params": params})
         self.record_training_end()
 
+        history = (np.concatenate(all_losses, axis=1).tolist()
+                   if all_losses else [])
         # history: per-worker per-epoch per-step losses
-        return self._finalize(params, np.asarray(losses).tolist())
+        return self._finalize(params, history)
 
 
 class EnsembleTrainer(DistributedTrainer):
@@ -98,54 +116,81 @@ class EnsembleTrainer(DistributedTrainer):
         super().__init__(keras_model, **kw)
         self.num_models = int(num_models)
 
-    def _cache_extras(self):
-        # the epoch count is the outer scan length -> part of the trace
-        return super()._cache_extras() + (self.num_epoch,)
-
     def train(self, dataset, shuffle=False):
+        import time as _time
+
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)
         mesh = self.mesh
-        num_epoch = self.num_epoch
+        step, opt_init = make_model_step(
+            model, loss_fn, tx, self.compute_dtype)
 
-        def build():
-            step, opt_init = make_model_step(
-                model, loss_fn, tx, self.compute_dtype)
-
-            def body(params, xs, ys, rng):
+        def build_chunk(E):
+            def body(params, opt_state, xs, ys, key, epoch0):
                 xs, ys = xs[0], ys[0]
                 rng = jax.random.fold_in(
-                    rng, jax.lax.axis_index(WORKER_AXIS))
-                params = tree_pvary(params)  # independent replicas
-                opt_state = opt_init(params)
+                    key, jax.lax.axis_index(WORKER_AXIS))
+                # carry arrives stacked (1, ...) per model replica
+                params = jax.tree.map(lambda t: t[0], params)
+                opt_state = jax.tree.map(lambda t: t[0], opt_state)
 
-                def epoch(carry, _):
-                    params, opt_state, rng = carry
-                    (params, opt_state, rng), losses = jax.lax.scan(
-                        step, (params, opt_state, rng), (xs, ys))
-                    return (params, opt_state, rng), losses
+                def epoch(carry, e):
+                    params, opt_state = carry
+                    erng = tree_pvary(jax.random.fold_in(rng, e))
+                    (params, opt_state, _), losses = jax.lax.scan(
+                        step, (params, opt_state, erng), (xs, ys))
+                    return (params, opt_state), losses
 
-                (params, _, _), losses = jax.lax.scan(
-                    epoch, (params, opt_state, rng), None, length=num_epoch)
-                stacked = jax.tree.map(lambda x: x[None], params)
-                return stacked, losses[None]
+                (params, opt_state), losses = jax.lax.scan(
+                    epoch, (params, opt_state), jnp.arange(E) + epoch0)
+                stack = lambda t: t[None]  # noqa: E731
+                return (jax.tree.map(stack, params),
+                        jax.tree.map(stack, opt_state), losses[None])
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-                out_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
+                in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(WORKER_AXIS), P(), P()),
+                out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
-        fn = self._compiled(build)
+        stacked = self._stack_workers(model.params)
+        opt_state = self._stack_workers(opt_init(model.params))
+        start_epoch, restored = self._maybe_resume(
+            {"params": stacked, "opt_state": opt_state})
+        if restored is not None:
+            stacked = restored["params"]
+            opt_state = restored["opt_state"]
+
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        key = jax.random.PRNGKey(self.seed)
+        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
         self.record_training_start()
-        stacked, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
-                             jax.random.PRNGKey(self.seed))
-        jax.block_until_ready(stacked)
+        all_losses = []
+        epochs_done = start_epoch
+        for E in self._chunk_plan(start_epoch):
+            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
+            t0 = _time.time()
+            stacked, opt_state, losses = fn(
+                stacked, opt_state, xs, ys, key, jnp.int32(epochs_done))
+            jax.block_until_ready(stacked)
+            dt = _time.time() - t0
+            epochs_done += E
+            losses = np.asarray(losses)
+            all_losses.append(losses)
+            self._emit_epoch_end(epochs_done, losses, dt,
+                                 samples_per_epoch * E)
+            self._maybe_checkpoint(
+                epochs_done,
+                lambda: {"params": stacked, "opt_state": opt_state})
         self.record_training_end()
-        self.history = np.asarray(losses).tolist()
+
+        self.history = (np.concatenate(all_losses, axis=1).tolist()
+                        if all_losses else [])
 
         models = []
         for i in range(self.num_models):

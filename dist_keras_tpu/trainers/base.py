@@ -29,7 +29,9 @@ class Trainer:
     def __init__(self, keras_model, loss="categorical_crossentropy",
                  worker_optimizer="adam", optimizer_kwargs=None,
                  features_col="features", label_col="label",
-                 batch_size=32, num_epoch=1, seed=0, compute_dtype=None):
+                 batch_size=32, num_epoch=1, seed=0, compute_dtype=None,
+                 checkpoint_dir=None, checkpoint_every=None,
+                 max_checkpoints=3, resume=False, callbacks=None):
         self.serialized_model = serialize_model(keras_model)
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -40,6 +42,22 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
+        # ---- mid-training hooks (beyond the reference: SURVEY §5 owes
+        # checkpoint/resume + structured metrics) ----
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = (int(checkpoint_every)
+                                 if checkpoint_every else None)
+        if self.checkpoint_every and not self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_every requires checkpoint_dir (otherwise the "
+                "dispatch would be chunked but nothing ever saved)")
+        if self.checkpoint_dir and self.checkpoint_every is None:
+            self.checkpoint_every = 1
+        self.max_checkpoints = int(max_checkpoints)
+        self.resume = bool(resume)
+        self.callbacks = list(callbacks or [])
+        self.metrics = []  # per-epoch {"epoch", "mean_loss", ...}
+        self._checkpointer = None
         self.history = []
         self._t_start = None
         self._t_stop = None
@@ -80,9 +98,11 @@ class Trainer:
     # weight copy per hyperparameter-sweep point.
     _jit_cache = {}
     _jit_cache_max = 32
-    # Non-string key components are tokened by id(); pin them so a GC'd
-    # object's address can never be reused by a different config.
-    _id_pins = []
+    # Non-string key components are tokened by id(); pin them (dict keyed
+    # by id, so repeated _cache_key calls — e.g. once per epoch chunk —
+    # never duplicate) so a GC'd object's address can never be reused by a
+    # different config.
+    _id_pins = {}
 
     def _cache_extras(self):
         """Subclass hook: hyperparameters baked into the trace."""
@@ -92,7 +112,7 @@ class Trainer:
         def _tok(v):
             if isinstance(v, str):
                 return v
-            Trainer._id_pins.append(v)
+            Trainer._id_pins[id(v)] = v
             return f"obj:{id(v)}"
 
         # num_epoch is deliberately absent: trainers that bake the epoch
@@ -106,8 +126,8 @@ class Trainer:
                 str(self.compute_dtype),
                 self._cache_extras())
 
-    def _compiled(self, builder):
-        key = self._cache_key()
+    def _compiled(self, builder, extra_key=()):
+        key = self._cache_key() + tuple(extra_key)
         cache = Trainer._jit_cache
         fn = cache.pop(key, None)
         if fn is None:
@@ -116,6 +136,74 @@ class Trainer:
                 cache.pop(next(iter(cache)))  # evict least recently used
         cache[key] = fn  # (re)insert at the back = most recent
         return fn
+
+    # ---- epoch chunking / checkpoint / callbacks ----------------------
+    # The whole num_epoch run compiles into ONE dispatch when no hooks are
+    # requested (fastest path, round-1 behavior).  checkpoint_every=K
+    # chunks the dispatch at K-epoch boundaries; any registered callback
+    # forces per-epoch chunks so on_epoch_end really fires every epoch.
+    def _chunk_plan(self, start_epoch=0):
+        remaining = self.num_epoch - start_epoch
+        if remaining <= 0:
+            return []
+        if self.callbacks:
+            size = 1
+        elif self.checkpoint_every:
+            size = min(self.checkpoint_every, remaining)
+        else:
+            size = remaining
+        chunks = [size] * (remaining // size)
+        if remaining % size:
+            chunks.append(remaining % size)
+        return chunks
+
+    def _checkpointer_or_none(self):
+        if self.checkpoint_dir and self._checkpointer is None:
+            from dist_keras_tpu.checkpoint import Checkpointer
+
+            self._checkpointer = Checkpointer(
+                self.checkpoint_dir, max_to_keep=self.max_checkpoints)
+        return self._checkpointer
+
+    def _maybe_resume(self, template):
+        """-> (start_epoch, restored_state | None)."""
+        ckptr = self._checkpointer_or_none()
+        if not (self.resume and ckptr is not None):
+            return 0, None
+        if ckptr.latest_step() is None:
+            return 0, None
+        step, state = ckptr.restore(template=template)
+        self._last_ckpt_epoch = int(step)
+        return int(step), state
+
+    def _maybe_checkpoint(self, epochs_done, state_fn):
+        """Save every ``checkpoint_every`` epochs since the last save (and
+        at the final epoch) — counted from the resume point, so resuming at
+        a non-multiple epoch never skips chunk-boundary saves.  ``state_fn``
+        is lazy so the host transfer only happens on save."""
+        ckptr = self._checkpointer_or_none()
+        if ckptr is None:
+            return
+        last = getattr(self, "_last_ckpt_epoch", 0)
+        cadence = self.checkpoint_every or self.num_epoch
+        if epochs_done - last >= cadence or epochs_done >= self.num_epoch:
+            ckptr.save(epochs_done, state_fn())
+            self._last_ckpt_epoch = epochs_done
+
+    def _emit_epoch_end(self, epochs_done, losses, seconds, samples):
+        """Record structured per-epoch metrics; fire callbacks."""
+        logs = {
+            "epoch": epochs_done,
+            "mean_loss": float(np.mean(losses)) if np.size(losses) else
+            float("nan"),
+            "seconds": float(seconds),
+            "samples_per_sec": float(samples / seconds) if seconds > 0
+            else float("nan"),
+        }
+        self.metrics.append(logs)
+        for cb in self.callbacks:
+            hook = getattr(cb, "on_epoch_end", cb)
+            hook(self, epochs_done, logs)
 
     # ---- shared plumbing ----
     def _fresh_model(self):
@@ -163,3 +251,28 @@ class DistributedTrainer(Trainer):
         return dataset.worker_shards(
             self.num_workers, self.batch_size,
             features_col=self.features_col, label_col=self.label_col)
+
+    def _stack_workers(self, tree):
+        """Replicate a pytree with a leading (num_workers,) axis — the
+        host-side layout of per-worker carry state (local replicas,
+        optimizer state) that crosses chunked-dispatch boundaries sharded
+        over the worker mesh axis.
+
+        The broadcast stays a zero-copy numpy view on the host and each
+        leaf is ``device_put`` directly with the worker sharding, so no
+        device ever holds more than its own (1, ...) shard — materializing
+        the full (workers, ...) stack on one chip could OOM where the
+        per-worker state fits fine."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+
+        n = self.num_workers
+        sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
+
+        def _stack(x):
+            x = np.asarray(x)
+            return jax.device_put(
+                np.broadcast_to(x[None], (n,) + x.shape), sharding)
+
+        return jax.tree.map(_stack, tree)

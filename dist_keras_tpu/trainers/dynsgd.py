@@ -40,21 +40,23 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _make_body(step, opt_init, window, num_workers, num_epoch):
-    def body(params, xs, ys, key):
+def _make_body(step, window, num_workers, num_epochs_chunk):
+    """Chunked scan body: runs ``num_epochs_chunk`` epochs from absolute
+    epoch ``epoch0`` with ALL per-worker state (pulled snapshot, local
+    replica, optimizer state, staleness counters) carried in/out, so the
+    staggered-staleness schedule survives checkpoint/resume boundaries."""
+    def body(center, pulled, local, opt_state, last_seen, global_count,
+             xs, ys, key, epoch0):
         xs, ys = xs[0], ys[0]
         widx = jax.lax.axis_index(WORKER_AXIS)
         phase = (widx * window) // num_workers  # staggered commit schedule
 
-        center = params
-        # pulled/local/opt_state/last_seen diverge per worker inside the
-        # scan; mark them device-varying up front (see tree_pvary — also
-        # required so local gradients stay local).
-        pulled = tree_pvary(params)
-        local = tree_pvary(params)
-        opt_state = tree_pvary(opt_init(params))
-        last_seen = tree_pvary(jnp.zeros((), jnp.int32))
-        global_count = jnp.zeros((), jnp.int32)
+        # per-worker carry arrives stacked (1, ...) on the worker shard
+        unstack = lambda t: t[0]  # noqa: E731
+        pulled = jax.tree.map(unstack, pulled)
+        local = jax.tree.map(unstack, local)
+        opt_state = jax.tree.map(unstack, opt_state)
+        last_seen = unstack(last_seen)
 
         def one_step(carry, inp):
             (center, pulled, local, opt_state, rng,
@@ -109,8 +111,14 @@ def _make_body(step, opt_init, window, num_workers, num_epoch):
                     last_seen, global_count), losses
 
         carry = (center, pulled, local, opt_state, last_seen, global_count)
-        carry, losses = jax.lax.scan(epoch, carry, jnp.arange(num_epoch))
-        return carry[0], losses[None]  # (1, epochs, steps)
+        carry, losses = jax.lax.scan(
+            epoch, carry, jnp.arange(num_epochs_chunk) + epoch0)
+        (center, pulled, local, opt_state, last_seen, global_count) = carry
+        stack = lambda t: t[None]  # noqa: E731
+        return (center, jax.tree.map(stack, pulled),
+                jax.tree.map(stack, local), jax.tree.map(stack, opt_state),
+                stack(last_seen), global_count,
+                losses[None])  # losses: (1, epochs, steps)
 
     return body
 
@@ -122,33 +130,82 @@ class DynSGD(DistributedTrainer):
         self.communication_window = int(communication_window)
 
     def _cache_extras(self):
-        return super()._cache_extras() + (
-            self.communication_window, self.num_epoch)
+        # the per-chunk epoch count is appended via _compiled(extra_key=)
+        return super()._cache_extras() + (self.communication_window,)
 
     def train(self, dataset, shuffle=False):
+        import time as _time
+
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
         mesh = self.mesh
+        step, opt_init = make_model_step(
+            model, loss_fn, tx, self.compute_dtype)
 
-        def build():
-            step, opt_init = make_model_step(
-                model, loss_fn, tx, self.compute_dtype)
+        def build_chunk(E):
             return jax.jit(shard_map(
-                _make_body(step, opt_init, self.communication_window,
-                           self.num_workers, self.num_epoch),
+                _make_body(step, self.communication_window,
+                           self.num_workers, E),
                 mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-                out_specs=(P(), P(WORKER_AXIS)),
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
+                out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                           P(WORKER_AXIS), P(WORKER_AXIS), P(),
+                           P(WORKER_AXIS)),
             ))
 
-        fn = self._compiled(build)
+        center = model.params
+        pulled = self._stack_workers(center)
+        local = self._stack_workers(center)
+        opt_state = self._stack_workers(opt_init(center))
+        last_seen = jnp.zeros((self.num_workers,), jnp.int32)
+        global_count = jnp.zeros((), jnp.int32)
+        template = {"center": center, "pulled": pulled, "local": local,
+                    "opt_state": opt_state, "last_seen": last_seen,
+                    "global_count": global_count}
+        start_epoch, restored = self._maybe_resume(template)
+        if restored is not None:
+            center = restored["center"]
+            pulled = restored["pulled"]
+            local = restored["local"]
+            opt_state = restored["opt_state"]
+            last_seen = restored["last_seen"]
+            global_count = restored["global_count"]
+
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        key = jax.random.PRNGKey(self.seed)
+        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
         self.record_training_start()
-        params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
-                            jax.random.PRNGKey(self.seed))
-        jax.block_until_ready(params)
+        all_losses = []
+        epochs_done = start_epoch
+        for E in self._chunk_plan(start_epoch):
+            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
+            t0 = _time.time()
+            (center, pulled, local, opt_state, last_seen, global_count,
+             losses) = fn(center, pulled, local, opt_state, last_seen,
+                          global_count, xs, ys, key,
+                          jnp.int32(epochs_done))
+            jax.block_until_ready(center)
+            dt = _time.time() - t0
+            epochs_done += E
+            losses = np.asarray(losses)  # (workers, E, steps)
+            all_losses.append(losses)
+            self._emit_epoch_end(epochs_done, losses, dt,
+                                 samples_per_epoch * E)
+            self._maybe_checkpoint(
+                epochs_done,
+                lambda: {"center": center, "pulled": pulled,
+                         "local": local, "opt_state": opt_state,
+                         "last_seen": last_seen,
+                         "global_count": global_count})
         self.record_training_end()
+
+        history = (np.concatenate(all_losses, axis=1).tolist()
+                   if all_losses else [])
         # history: (workers, epochs, steps)
-        return self._finalize(params, np.asarray(losses).tolist())
+        return self._finalize(center, history)

@@ -18,6 +18,8 @@ from dist_keras_tpu.trainers.step import make_model_step, scan_epoch
 
 class SingleTrainer(Trainer):
     def train(self, dataset, shuffle=False):
+        import time as _time
+
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
@@ -30,6 +32,13 @@ class SingleTrainer(Trainer):
         opt_state = opt_init(params)
         rng = jax.random.PRNGKey(self.seed)
 
+        start_epoch, restored = self._maybe_resume(
+            {"params": params, "opt_state": opt_state, "rng": rng})
+        if restored is not None:
+            params = restored["params"]
+            opt_state = restored["opt_state"]
+            rng = jnp.asarray(restored["rng"])
+
         def build():
             @jax.jit
             def run_epoch(params, opt_state, rng, xb, yb):
@@ -41,14 +50,23 @@ class SingleTrainer(Trainer):
 
         xb = jnp.asarray(xb)
         yb = jnp.asarray(yb)
+        samples_per_epoch = xb.shape[0] * self.batch_size
 
         self.record_training_start()
         losses = []
-        for _ in range(self.num_epoch):
+        for e in range(start_epoch, self.num_epoch):
+            t0 = _time.time()
             params, opt_state, rng, ls = run_epoch(
                 params, opt_state, rng, xb, yb)
-            losses.append(np.asarray(ls))
-        jax.block_until_ready(params)
+            jax.block_until_ready(params)
+            dt = _time.time() - t0
+            ls = np.asarray(ls)
+            losses.append(ls)
+            self._emit_epoch_end(e + 1, ls, dt, samples_per_epoch)
+            self._maybe_checkpoint(
+                e + 1, lambda: {"params": params, "opt_state": opt_state,
+                                "rng": rng})
         self.record_training_end()
 
-        return self._finalize(params, np.concatenate(losses).tolist())
+        history = (np.concatenate(losses).tolist() if losses else [])
+        return self._finalize(params, history)

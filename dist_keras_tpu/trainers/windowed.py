@@ -71,9 +71,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         self.parallelism_factor = int(parallelism_factor)
 
     def _cache_extras(self):
-        # num_epoch is the outer scan length -> part of the trace
-        return super()._cache_extras() + (
-            self.communication_window, self.num_epoch)
+        # the per-chunk epoch count is appended via _compiled(extra_key=)
+        return super()._cache_extras() + (self.communication_window,)
 
     # --- strategy hooks -------------------------------------------------
     def wrap_optimizer(self, tx):
@@ -86,11 +85,15 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     # --- shared training loop ------------------------------------------
     def train(self, dataset, shuffle=False):
-        """One H2D transfer, one dispatch: epochs are an outer ``lax.scan``
-        over the same device-resident shard tensors (no tiling, no
-        re-transfer).  Worker state (local replicas, optimizer state)
-        persists across epochs, exactly as a long-lived reference worker's
-        does (workers.py:~150)."""
+        """Epochs run as an outer ``lax.scan`` over device-resident shard
+        tensors (one H2D transfer).  With no hooks requested the whole
+        num_epoch run is ONE dispatch; ``checkpoint_every``/``callbacks``
+        chunk the dispatch at epoch boundaries, with all worker state
+        (local replicas, optimizer state) carried across chunks — exactly
+        as a long-lived reference worker's state persists
+        (workers.py:~150) — so training is resumable mid-run."""
+        import time as _time
+
         model, loss_fn, tx = self._resolve()
         tx = self.wrap_optimizer(tx)
         if shuffle:
@@ -119,20 +122,16 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
         mesh = self.mesh
         merge = self.merge
-        num_epoch = self.num_epoch
+        step, opt_init = make_model_step(
+            model, loss_fn, tx, self.compute_dtype)
 
-        def build():
-            step, opt_init = make_model_step(
-                model, loss_fn, tx, self.compute_dtype)
-
-            def body(params, xs, ys, key):
+        def build_chunk(E):
+            def body(center, local, opt_state, xs, ys, key, epoch0):
                 xs, ys = xs[0], ys[0]  # (windows, W, batch, ...)
                 widx = jax.lax.axis_index(WORKER_AXIS)
-                center = params
-                # Local replica state must be explicitly worker-varying or
-                # the backward pass silently psums gradients (tree_pvary).
-                local = tree_pvary(params)
-                opt_state = opt_init(local)
+                # carry state arrives stacked (1, ...) per worker shard
+                local = jax.tree.map(lambda t: t[0], local)
+                opt_state = jax.tree.map(lambda t: t[0], opt_state)
 
                 def window(carry, batch):
                     center, local, opt_state, rng = carry
@@ -157,26 +156,64 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                         window, (center, local, opt_state, rng), (xs, ys))
                     return (center, local, opt_state), losses
 
-                (center, _, _), losses = jax.lax.scan(
+                (center, local, opt_state), losses = jax.lax.scan(
                     epoch, (center, local, opt_state),
-                    jnp.arange(num_epoch))
-                return center, losses[None]  # (1, epochs, windows, W)
+                    jnp.arange(E) + epoch0)
+                stack = lambda t: t[None]  # noqa: E731
+                return (center, jax.tree.map(stack, local),
+                        jax.tree.map(stack, opt_state), losses[None])
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
-                out_specs=(P(), P(WORKER_AXIS)),
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
+                out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                           P(WORKER_AXIS)),
             ))
 
-        fn = self._compiled(build)
+        # initial carry (stacked per worker on the leading axis)
+        center = model.params
+        local = self._stack_workers(center)
+        opt_state = self._stack_workers(opt_init(center))
+        template = {"center": center, "local": local,
+                    "opt_state": opt_state}
+        start_epoch, restored = self._maybe_resume(template)
+        if restored is not None:
+            center = restored["center"]
+            local = restored["local"]
+            opt_state = restored["opt_state"]
+
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        key = jax.random.PRNGKey(self.seed)
+        samples_per_epoch = self.num_workers * windows * W * self.batch_size
 
         self.record_training_start()
-        params, losses = fn(model.params, jnp.asarray(xs), jnp.asarray(ys),
-                            jax.random.PRNGKey(self.seed))
-        jax.block_until_ready(params)
+        all_losses = []
+        epochs_done = start_epoch
+        for E in self._chunk_plan(start_epoch):
+            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
+            t0 = _time.time()
+            center, local, opt_state, losses = fn(
+                center, local, opt_state, xs, ys, key,
+                jnp.int32(epochs_done))
+            jax.block_until_ready(center)
+            dt = _time.time() - t0
+            epochs_done += E
+            losses = np.asarray(losses)  # (workers, E, windows, W)
+            all_losses.append(losses)
+            self._emit_epoch_end(epochs_done, losses, dt,
+                                 samples_per_epoch * E)
+            self._maybe_checkpoint(
+                epochs_done,
+                lambda: {"center": center, "local": local,
+                         "opt_state": opt_state})
         self.record_training_end()
+
+        history = (np.concatenate(all_losses, axis=1).tolist()
+                   if all_losses else [])
         # history: (workers, epochs, windows, W)
-        return self._finalize(params, np.asarray(losses).tolist())
+        return self._finalize(center, history)
 
 
 class DOWNPOUR(AsynchronousDistributedTrainer):
