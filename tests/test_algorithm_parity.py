@@ -17,7 +17,7 @@ import pytest
 from dist_keras_tpu.data import Dataset
 from dist_keras_tpu.models import mnist_mlp
 from dist_keras_tpu.ops.losses import get_loss
-from dist_keras_tpu.trainers import ADAG, AEASGD, DOWNPOUR
+from dist_keras_tpu.trainers import ADAG, AEASGD, DOWNPOUR, EAMSGD, DynSGD
 from dist_keras_tpu.utils.misc import one_hot
 
 N_WORKERS, WINDOW, BATCH, DIM, CLASSES = 4, 2, 8, 6, 3
@@ -127,6 +127,127 @@ def test_aeasgd_matches_simulation():
     got = _trainer_center(AEASGD, model, ds, lr,
                           rho=rho, learning_rate=elastic_lr)
     _assert_tree_close(want, got)
+
+
+def test_eamsgd_matches_simulation():
+    """EAMSGD = AEASGD + Nesterov momentum on the *local* update
+    (windowed.py wrap_optimizer; reference workers.py:~450).  The
+    simulator places the momentum trace exactly where the trainer does —
+    after the sgd scaling, per worker, persisting across commits — so a
+    momentum-placement regression (e.g. momentum applied to the elastic
+    exchange, or trace reset at commits) fails this test."""
+    ds = _data()
+    model = mnist_mlp(hidden=(8,), input_dim=DIM, num_classes=CLASSES)
+    lr, elastic_lr, rho, decay = 0.1, 0.05, 1.0, 0.9
+    alpha = elastic_lr * rho
+    loss_fn = get_loss("categorical_crossentropy")
+    xs, ys = ds.worker_shards(N_WORKERS, BATCH, label_col="label_encoded")
+    steps = xs.shape[1]
+    windows = steps // WINDOW
+
+    def grad(params, x, y):
+        return jax.grad(
+            lambda p: loss_fn(model.apply(p, jnp.asarray(x)),
+                              jnp.asarray(y)))(params)
+
+    center = model.params
+    locals_ = [center] * N_WORKERS
+    zeros = jax.tree.map(jnp.zeros_like, center)
+    traces = [zeros] * N_WORKERS  # optax.trace state, never reset
+    for w in range(windows):
+        for i in range(N_WORKERS):
+            p, tr = locals_[i], traces[i]
+            for s in range(WINDOW):
+                t = w * WINDOW + s
+                g = grad(p, xs[i, t], ys[i, t])
+                u = jax.tree.map(lambda a: -lr * a, g)          # sgd scale
+                tr = jax.tree.map(lambda a, b: a + decay * b, u, tr)
+                upd = jax.tree.map(lambda a, b: a + decay * b, u,
+                                   tr)                          # nesterov
+                p = jax.tree.map(jnp.add, p, upd)
+            locals_[i], traces[i] = p, tr
+        # elastic merge — identical to AEASGD, momentum NOT involved
+        new_center = center
+        for i in range(N_WORKERS):
+            e = jax.tree.map(lambda a, b: alpha * (a - b),
+                             locals_[i], center)
+            locals_[i] = jax.tree.map(jnp.subtract, locals_[i], e)
+            new_center = jax.tree.map(jnp.add, new_center, e)
+        center = new_center
+
+    got = _trainer_center(EAMSGD, model, ds, lr, rho=rho,
+                          learning_rate=elastic_lr, momentum=decay)
+    _assert_tree_close(center, got)
+
+
+def test_dynsgd_matches_staggered_simulation():
+    """DynSGD's staggered-staleness scan (dynsgd.py) vs a sequential
+    simulator that reproduces the schedule step by step: worker ``i``
+    commits when ``(t+1+phase_i) % W == 0`` with ``phase_i = i*W//N``;
+    each commit is scaled by ``1/(staleness+1)`` where staleness counts
+    center updates since the worker's last pull (reference
+    parameter_servers.py:~280).  Asserts the staleness counters and the
+    scaling bitwise-close through the center variable, and that the
+    schedule really produced nonzero staleness (otherwise the test would
+    degenerate to DOWNPOUR and prove nothing)."""
+    W = 4  # with N_WORKERS=4: phases [0,1,2,3] — fully staggered
+    steps = 8
+    rows = N_WORKERS * steps * BATCH
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(rows, DIM)).astype(np.float32)
+    y = rng.integers(0, CLASSES, rows)
+    ds = Dataset({"features": x, "label": y,
+                  "label_encoded": one_hot(y, CLASSES)})
+    model = mnist_mlp(hidden=(8,), input_dim=DIM, num_classes=CLASSES)
+    lr = 0.1
+    loss_fn = get_loss("categorical_crossentropy")
+    xs, ys = ds.worker_shards(N_WORKERS, BATCH, label_col="label_encoded")
+    assert xs.shape[1] == steps
+
+    def grad(params, x, y):
+        return jax.grad(
+            lambda p: loss_fn(model.apply(p, jnp.asarray(x)),
+                              jnp.asarray(y)))(params)
+
+    phases = [(i * W) // N_WORKERS for i in range(N_WORKERS)]
+    center = model.params
+    pulled = [center] * N_WORKERS
+    locals_ = [center] * N_WORKERS
+    last_seen = [0] * N_WORKERS
+    global_count = 0
+    max_staleness = 0
+    for t in range(steps):
+        for i in range(N_WORKERS):  # every worker steps locally
+            g = grad(locals_[i], xs[i, t], ys[i, t])
+            locals_[i] = jax.tree.map(lambda a, b: a - lr * b,
+                                      locals_[i], g)
+        commits = [(t + 1 + phases[i]) % W == 0 for i in range(N_WORKERS)]
+        # scales use global_count BEFORE this step's commits land
+        total = jax.tree.map(jnp.zeros_like, center)
+        for i in range(N_WORKERS):
+            if not commits[i]:
+                continue
+            staleness = global_count - last_seen[i]
+            max_staleness = max(max_staleness, staleness)
+            scale = 1.0 / (staleness + 1.0)
+            total = jax.tree.map(
+                lambda acc, l, p: acc + scale * (l - p),
+                total, locals_[i], pulled[i])
+        center = jax.tree.map(jnp.add, center, total)
+        global_count += sum(commits)
+        for i in range(N_WORKERS):
+            if commits[i]:
+                locals_[i] = center
+                pulled[i] = center
+                last_seen[i] = global_count
+
+    assert max_staleness > 0  # the schedule must exercise the scaling
+
+    t = DynSGD(model, num_workers=N_WORKERS, communication_window=W,
+               worker_optimizer="sgd", optimizer_kwargs={"learning_rate": lr},
+               batch_size=BATCH, num_epoch=1, label_col="label_encoded")
+    got = t.train(ds).params
+    _assert_tree_close(center, got)
 
 
 def test_workers_actually_diverge_between_commits():
