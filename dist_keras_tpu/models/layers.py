@@ -158,6 +158,39 @@ class Dense(Layer):
         return ["kernel", "bias"] if self.use_bias else ["kernel"]
 
 
+def _conv_im2col(x, kernel, strides, padding):
+    """NHWC conv as shifted-slice im2col + one matmul, or None if the
+    config isn't supported.
+
+    XLA:CPU pathology (measured on this image): the *gradient* convs
+    (weight-grad / input-grad) inside a rolled ``lax.scan`` body lose the
+    Eigen fast path and run ~80x slower than the same ops unrolled — which
+    made every scanned CNN epoch unusable on the CPU test harness.  Slices
+    and matmuls keep their fast paths (and their VJPs are slices/matmuls
+    again), so on the CPU backend convs are lowered this way; TPU keeps the
+    native MXU conv above.  Numerically identical to lax conv (~1e-7).
+    """
+    kh, kw, cin, cout = kernel.shape
+    sh, sw = strides
+    n, h, w, _ = x.shape
+    if padding == "SAME":
+        oh, ow = -(-h // sh), -(-w // sw)
+        ph = max(0, (oh - 1) * sh + kh - h)
+        pw = max(0, (ow - 1) * sw + kw - w)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)))
+    elif padding == "VALID":
+        oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    else:
+        return None
+    if oh <= 0 or ow <= 0:
+        return None
+    cols = jnp.concatenate(
+        [x[:, i:i + sh * (oh - 1) + 1:sh, j:j + sw * (ow - 1) + 1:sw, :]
+         for i in range(kh) for j in range(kw)], axis=-1)
+    return cols @ kernel.reshape(kh * kw * cin, cout).astype(cols.dtype)
+
+
 @register_layer
 class Conv2D(Layer):
     """NHWC conv. Kernel layout HWIO (XLA:TPU native)."""
@@ -184,6 +217,10 @@ class Conv2D(Layer):
         return params, tuple(out.shape[1:])
 
     def _conv(self, x, kernel):
+        if jax.default_backend() == "cpu":
+            y = _conv_im2col(x, kernel, self.strides, self.padding.upper())
+            if y is not None:
+                return y
         return lax.conv_general_dilated(
             x, kernel, window_strides=self.strides,
             padding=self.padding.upper(),
@@ -208,6 +245,7 @@ class Conv2D(Layer):
 class _Pool2D(Layer):
     _reducer = None
     _init_val = None
+    _np_reducer = None
 
     def __init__(self, pool_size=(2, 2), strides=None, padding="valid"):
         self.pool_size = tuple(np.broadcast_to(pool_size, (2,)).tolist())
@@ -224,6 +262,20 @@ class _Pool2D(Layer):
     def _pool(self, x):
         ph, pw = self.pool_size
         sh, sw = self.strides
+        n, h, w, c = x.shape
+        # Non-overlapping, evenly-dividing windows (the common CNN case)
+        # reduce over a reshape: same forward result as reduce_window, but
+        # the VJP is slices/broadcasts instead of select-and-scatter —
+        # which, like grad-convs, collapses off the fast path inside
+        # scanned loop bodies on XLA:CPU (see _conv_im2col).  VJP caveat:
+        # at *tied* window maxima jnp.max splits the cotangent evenly
+        # while select-and-scatter routes it all to the first maximum;
+        # both are valid subgradients but trajectories can differ on
+        # quantized/replicated activations.
+        if ((sh, sw) == (ph, pw) and h % ph == 0 and w % pw == 0
+                and self._np_reducer is not None):
+            xr = x.reshape(n, h // ph, ph, w // pw, pw, c)
+            return self._np_reducer(xr, axis=(2, 4))
         return lax.reduce_window(
             x, self._init_val, self._reducer,
             window_dimensions=(1, ph, pw, 1),
@@ -238,6 +290,8 @@ class _Pool2D(Layer):
 
 @register_layer
 class MaxPool2D(_Pool2D):
+    _np_reducer = staticmethod(jnp.max)
+
     def apply(self, params, x, *, training=False, rng=None):
         self._reducer = lax.max
         self._init_val = -jnp.inf
@@ -246,6 +300,8 @@ class MaxPool2D(_Pool2D):
 
 @register_layer
 class AvgPool2D(_Pool2D):
+    _np_reducer = staticmethod(jnp.sum)
+
     def apply(self, params, x, *, training=False, rng=None):
         self._reducer = lax.add
         self._init_val = 0.0

@@ -15,17 +15,25 @@ from __future__ import annotations
 import optax
 
 
-def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False):
-    return optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov)
+def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False, warmup_steps=0):
+    """``warmup_steps`` > 0 ramps the lr linearly from 0 — the "lr warmup"
+    of the DOWNPOUR BASELINE.md config (stabilizes the async family's first
+    windows, where every worker commits against a cold center)."""
+    lr = (optax.linear_schedule(0.0, learning_rate, int(warmup_steps))
+          if warmup_steps else learning_rate)
+    return optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
 
 
 def _adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-7):
     return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
 
 
-def _adagrad(learning_rate=1e-3, initial_accumulator_value=0.1, eps=1e-7):
+def _adagrad(learning_rate=1e-3, initial_accumulator_value=0.1, eps=1e-7,
+             warmup_steps=0):
+    lr = (optax.linear_schedule(0.0, learning_rate, int(warmup_steps))
+          if warmup_steps else learning_rate)
     return optax.adagrad(
-        learning_rate,
+        lr,
         initial_accumulator_value=initial_accumulator_value,
         eps=eps,
     )

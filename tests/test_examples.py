@@ -1,0 +1,201 @@
+"""Accuracy-parity gates for the five BASELINE.md configs.
+
+The reference has no test suite; its examples double as integration tests
+(SURVEY.md §4): every trainer runs on the same MNIST DataFrame and accuracies
+are compared by hand.  These tests are that comparison, automated, with hard
+thresholds, on faithfully-shaped procedural data (data/synthetic.py — this
+image has no network, so real MNIST/Higgs/CIFAR can't be downloaded; the
+synthetic sets match shape/range/difficulty: a linear model scores ~0.94 on
+the MNIST set vs ~0.92 on real MNIST, ~0.89 AUC on the Higgs set).
+
+BASELINE.md config -> gate:
+1. SingleTrainer — MNIST MLP ......... test_single_mnist_mlp   (acc >= 0.90)
+2. ADAG — MNIST CNN, window=12 ....... test_adag_mnist_cnn     (acc >= 0.90)
+3. DOWNPOUR — MNIST CNN .............. test_downpour_mnist_cnn (acc >= 0.90)
+4. AEASGD / EAMSGD — Higgs ........... test_aeasgd_eamsgd_higgs (AUC >= 0.85)
+5. DynSGD — CIFAR-10 ConvNet ......... test_dynsgd_cifar10     (acc >= 0.50,
+   ~6x chance after 4 epochs; the full config lives in
+   examples/cifar10_dynsgd.py)
+
+Hyperparameter notes (lockstep-SPMD dynamics differ from the reference's
+async interleaving — SURVEY.md §7 "hard parts"):
+- DOWNPOUR commits the raw sum of worker deltas, so the center's step grows
+  linearly with num_workers; at 8 workers on a CNN it explodes for any lr
+  large enough to learn (the reference hit the same wall — ADAG's
+  window-normalisation exists precisely to fix DOWNPOUR's degradation at
+  worker count).  The gate runs the stable 4-worker config.
+- AEASGD's elastic strength alpha = lr*rho must keep alpha*num_workers <= 1
+  under simultaneous commits; the reference's async defaults (rho=5,
+  lr=0.1) oscillate when applied in lockstep, so the gates use rho=1,
+  lr=0.2 with 4 workers.
+"""
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.data import (
+    AccuracyEvaluator,
+    AUCEvaluator,
+    Dataset,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    ModelPredictor,
+    OneHotTransformer,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+)
+from dist_keras_tpu.data.synthetic import (
+    synthetic_cifar10,
+    synthetic_higgs,
+    synthetic_mnist,
+    to_csv,
+)
+from dist_keras_tpu.models import (
+    cifar10_convnet,
+    higgs_mlp,
+    mnist_cnn,
+    mnist_mlp,
+)
+from dist_keras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    EAMSGD,
+    DynSGD,
+    SingleTrainer,
+)
+
+
+# ---------------------------------------------------------------------------
+# data fixtures (session-scoped: generated once for all gates)
+# ---------------------------------------------------------------------------
+def _prep_mnist(ds):
+    ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0, input_col="features",
+                           output_col="fn").transform(ds)
+    ds = OneHotTransformer(10, input_col="label",
+                           output_col="le").transform(ds)
+    return ReshapeTransformer(input_col="fn", output_col="fi",
+                              shape=(28, 28, 1)).transform(ds)
+
+
+@pytest.fixture(scope="session")
+def mnist_train():
+    return _prep_mnist(synthetic_mnist(4096, seed=0))
+
+
+@pytest.fixture(scope="session")
+def mnist_test():
+    return _prep_mnist(synthetic_mnist(1024, seed=1))
+
+
+@pytest.fixture(scope="session")
+def higgs_data():
+    def prep(n, seed):
+        ds = synthetic_higgs(n, seed=seed)
+        ds = StandardScaleTransformer(input_col="features",
+                                      output_col="fs").transform(ds)
+        return OneHotTransformer(2, input_col="label",
+                                 output_col="le").transform(ds)
+
+    return prep(8192, 0), prep(2048, 1)
+
+
+def _accuracy(model, test, features_col):
+    pred = ModelPredictor(model, features_col=features_col).predict(test)
+    pred = LabelIndexTransformer(input_col="prediction").transform(pred)
+    return AccuracyEvaluator(prediction_col="prediction_index",
+                             label_col="label").evaluate(pred)
+
+
+# ---------------------------------------------------------------------------
+# gate 1: SingleTrainer — MNIST MLP (through the CSV ingestion path)
+# ---------------------------------------------------------------------------
+def test_single_mnist_mlp(tmp_path, mnist_test):
+    # round-trip through the native CSV parser: the reference example's
+    # ingestion path (examples/mnist.py loads MNIST from CSV)
+    raw = synthetic_mnist(4096, seed=0)
+    path = str(tmp_path / "mnist_train.csv")
+    to_csv(raw, path)
+    train = _prep_mnist(Dataset.from_csv(path, label="label"))
+
+    t = SingleTrainer(mnist_mlp(), worker_optimizer="adam",
+                      optimizer_kwargs={"learning_rate": 1e-3},
+                      batch_size=64, num_epoch=6,
+                      features_col="fn", label_col="le")
+    trained = t.train(train, shuffle=True)
+    acc = _accuracy(trained, mnist_test, "fn")
+    assert acc >= 0.90, f"SingleTrainer MNIST MLP accuracy {acc}"
+
+
+# ---------------------------------------------------------------------------
+# gate 2: ADAG — MNIST CNN, communication_window=12
+# ---------------------------------------------------------------------------
+def test_adag_mnist_cnn(mnist_train, mnist_test):
+    t = ADAG(mnist_cnn(), num_workers=4, communication_window=12,
+             worker_optimizer="adam",
+             optimizer_kwargs={"learning_rate": 3e-3},
+             batch_size=64, num_epoch=6,
+             features_col="fi", label_col="le")
+    trained = t.train(mnist_train, shuffle=True)
+    acc = _accuracy(trained, mnist_test, "fi")
+    assert acc >= 0.90, f"ADAG MNIST CNN accuracy {acc}"
+
+
+# ---------------------------------------------------------------------------
+# gate 3: DOWNPOUR — MNIST CNN (stable 4-worker config, see module doc)
+# ---------------------------------------------------------------------------
+def test_downpour_mnist_cnn(mnist_train, mnist_test):
+    t = DOWNPOUR(mnist_cnn(), num_workers=4, communication_window=5,
+                 worker_optimizer="adam",
+                 optimizer_kwargs={"learning_rate": 7e-4},
+                 batch_size=64, num_epoch=12,
+                 features_col="fi", label_col="le")
+    trained = t.train(mnist_train, shuffle=True)
+    acc = _accuracy(trained, mnist_test, "fi")
+    assert acc >= 0.90, f"DOWNPOUR MNIST CNN accuracy {acc}"
+
+
+# ---------------------------------------------------------------------------
+# gate 4: AEASGD / EAMSGD — ATLAS-Higgs dense classifier
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cls,extra", [
+    (AEASGD, {}),
+    (EAMSGD, {"momentum": 0.9}),
+])
+def test_aeasgd_eamsgd_higgs(higgs_data, cls, extra):
+    train, test = higgs_data
+    t = cls(higgs_mlp(), num_workers=4, communication_window=16,
+            rho=1.0, learning_rate=0.2,
+            worker_optimizer="adam",
+            optimizer_kwargs={"learning_rate": 1e-3},
+            batch_size=64, num_epoch=10,
+            features_col="fs", label_col="le", **extra)
+    trained = t.train(train, shuffle=True)
+    pred = ModelPredictor(trained, features_col="fs").predict(test)
+    auc = AUCEvaluator(score_col="prediction",
+                       label_col="label").evaluate(pred)
+    assert auc >= 0.85, f"{cls.__name__} Higgs AUC {auc}"
+
+
+# ---------------------------------------------------------------------------
+# gate 5: DynSGD — CIFAR-10 ConvNet, 8 workers (CI-sized)
+# ---------------------------------------------------------------------------
+def test_dynsgd_cifar10():
+    def prep(n, seed):
+        ds = synthetic_cifar10(n, seed=seed)
+        ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0, input_col="features",
+                               output_col="fn").transform(ds)
+        ds = OneHotTransformer(10, input_col="label",
+                               output_col="le").transform(ds)
+        return ReshapeTransformer(input_col="fn", output_col="fi",
+                                  shape=(32, 32, 3)).transform(ds)
+
+    train, test = prep(2048, 0), prep(512, 1)
+    t = DynSGD(cifar10_convnet(), num_workers=8, communication_window=5,
+               worker_optimizer="adam",
+               optimizer_kwargs={"learning_rate": 1e-3},
+               batch_size=32, num_epoch=4,
+               features_col="fi", label_col="le")
+    trained = t.train(train, shuffle=True)
+    acc = _accuracy(trained, test, "fi")
+    assert acc >= 0.50, f"DynSGD CIFAR-10 accuracy {acc} (chance = 0.10)"

@@ -79,3 +79,32 @@ def test_set_weights_shape_check():
     ws[0] = np.zeros((5, 8), np.float32)
     with pytest.raises(ValueError):
         m.set_weights(ws)
+
+
+def test_sgd_warmup_schedule():
+    """warmup_steps ramps the lr linearly from 0 to the target (the
+    BASELINE.md DOWNPOUR 'lr warmup' knob) and stays there after."""
+    import jax.numpy as jnp
+
+    from dist_keras_tpu.ops.optimizers import get_optimizer
+
+    tx = get_optimizer("sgd", learning_rate=0.1, warmup_steps=4)
+    params = {"w": jnp.ones(())}
+    grads = {"w": jnp.ones(())}
+    state = tx.init(params)
+    steps = []
+    for _ in range(8):
+        updates, state = tx.update(grads, state, params)
+        steps.append(float(-updates["w"]))
+    # linear_schedule(0, lr, 4): lr(t) = lr * t/4 for t<4, then lr
+    np.testing.assert_allclose(steps[:4], [0.0, 0.025, 0.05, 0.075],
+                               atol=1e-7)
+    np.testing.assert_allclose(steps[4:], [0.1] * 4, atol=1e-7)
+
+    # adagrad variant ramps too (step 0 must be exactly 0)
+    tx = get_optimizer("adagrad", learning_rate=0.1, warmup_steps=2)
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    assert float(updates["w"]) == 0.0
+    updates, state = tx.update(grads, state, params)
+    assert float(updates["w"]) < 0.0
