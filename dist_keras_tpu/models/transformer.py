@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dist_keras_tpu.models.layers import glorot_uniform
-from dist_keras_tpu.ops.attention import attention
+from dist_keras_tpu.ops.attention import attention  # noqa: F401 (oracle)
 
 
 def transformer_config(input_dim, seq_len, d_model=64, n_heads=4,
@@ -83,12 +83,19 @@ def layer_norm(p, x, eps=1e-5):
 _ln = layer_norm
 
 
-def transformer_apply(params, x, cfg, *, causal=False, attn_fn=attention):
+def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
     """Forward pass.  x: (B, T, input_dim) -> logits (B, n_classes).
 
     ``attn_fn`` is injectable so the sharded step can swap in
-    ``ring_attention`` while reusing every other line of this function.
+    ``ring_attention`` while reusing every other line of this function;
+    the default dispatches to the Pallas flash kernel on TPU backends and
+    the jnp reference elsewhere (``attention_auto``).  Pass
+    ``attn_fn=attention`` to force the jnp oracle.
     """
+    if attn_fn is None:
+        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
+
+        attn_fn = attention_auto
     h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
     for blk in params["blocks"]:
         y = _ln(blk["ln1"], h)

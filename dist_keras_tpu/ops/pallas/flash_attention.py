@@ -1,19 +1,34 @@
-"""Pallas TPU attention kernel (blockwise-Q, fused softmax).
+"""Pallas TPU flash attention: blockwise Q *and* K/V, forward + backward.
 
-The hot op of the transformer path gets a hand-written kernel: one grid
-program per (batch x head, Q block) computes ``softmax(q K^T) V`` entirely
-in VMEM — logits never round-trip to HBM, the two matmuls hit the MXU back
-to back, and the softmax runs on the VPU between them.  Q is blocked
-(``block_q`` rows per program) while each program streams the full K/V for
-its batch-head, which fits VMEM for the sequence lengths the framework's
-ring attention shards down to (T_local x D x 4B; ~1 MB at T=2048, D=128).
+The hot op of the transformer path (SURVEY.md §2.2: native-code effort
+belongs in Pallas kernels).  Structure is the standard TPU flash attention:
 
-Backward uses a custom VJP that recomputes through the jnp reference
-(`ops.attention.attention`) — the standard recompute trade: no residual
-logits stored, XLA fuses the backward matmuls itself.
+- **Forward**: grid ``(batch*heads, q_blocks, kv_blocks)``, kv innermost.
+  Each program folds one (block_q x block_k) tile into an online-softmax
+  accumulator held in VMEM scratch (running max m, denominator l,
+  unnormalised output acc); the normalised output block and the row
+  logsumexp are written once, on the last kv step.  Logits never exist in
+  HBM at any tile size, and VMEM stays O(block_q x block_k + block x d)
+  regardless of sequence length — the round-1 kernel streamed the *full*
+  K/V per program, which capped T at VMEM size.
+- **Backward**: two Pallas kernels recomputing probabilities from the saved
+  logsumexp (no logits residual): ``dq`` accumulates over kv blocks with
+  the same grid as forward; ``dk/dv`` uses grid ``(bh, kv_blocks,
+  q_blocks)`` so each program owns one K/V block and streams Q/dO.
+  ``dS = P * (dO V^T - delta + g_lse)`` where ``delta = rowsum(dO * O)``
+  (computed in jnp) and ``g_lse`` is the logsumexp cotangent — nonzero
+  when ring attention's block-merge differentiates through the lse.
+- **lse output**: the kernel returns ``(out, logsumexp)`` so sequence
+  parallelism can merge per-device blocks exactly
+  (``ops/attention.py: ring_attention``) — lse carries real gradients
+  there, hence the ``g_lse`` term above.
 
-Off-TPU (tests, CPU meshes) the same kernel runs under ``interpret=True``,
-keeping one code path; `attention_auto` picks the fast route per backend.
+Causal masking uses global positions via ``q_offset``/``kv_offset`` (static
+ints) so ring attention's shifted blocks mask correctly.  Tiles entirely
+above the causal diagonal are skipped with ``pl.when``.
+
+Off-TPU (tests, CPU meshes) the same kernels run under ``interpret=True``;
+``attention_auto`` dispatches per backend at trace time.
 """
 
 from __future__ import annotations
@@ -24,92 +39,361 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from dist_keras_tpu.ops.attention import attention as _reference_attention
+try:  # TPU-specific pallas helpers (absent in CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from dist_keras_tpu.ops.attention import attention_with_lse as _ref_with_lse
 
 _NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
-    k = k_ref[0].astype(jnp.float32)           # (T, D)
-    v = v_ref[0].astype(jnp.float32)           # (T, D)
-    logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # (BQ, T)
-    if causal:
-        t = k.shape[0]
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
-    m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) / l
-    o_ref[0] = out.astype(o_ref.dtype)
+def use_pallas():
+    """Single source of truth for the TPU-backend dispatch predicate
+    (shared with ``ops.attention._auto_block_fn``)."""
+    return jax.default_backend() in ("tpu", "axon")
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
-    b, t, h, d = q.shape
-    scale = (d ** -0.5) if scale is None else scale
-    block_q = min(block_q, t)
-    if t % block_q:
-        # fall back: uneven Q blocks (rare; tests use small T)
-        return _reference_attention(q, k, v, causal=causal, scale=scale)
+def _require_tpu_helpers():
+    if _VMEM is None:  # pragma: no cover - CPU-only jax builds
+        raise ImportError(
+            "jax.experimental.pallas.tpu is unavailable in this jax build; "
+            "the flash kernels need its VMEM scratch allocators even in "
+            "interpret mode. Use ops.attention.attention instead.")
 
-    # (B, T, H, D) -> (B*H, T, D)
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
+def _compiler_params(interpret):
+    """bh / outer block dims are embarrassingly parallel; the innermost
+    grid dim carries the online-softmax scratch, so it must stay
+    sequential ('arbitrary')."""
+    if interpret or pltpu is None:
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))}
+
+
+def _causal_mask(logits, qi, ki, block_q, block_k, q_offset, kv_offset):
+    qpos = (q_offset + qi * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0))
+    kpos = (kv_offset + ki * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1))
+    return jnp.where(qpos >= kpos, logits, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, q_offset, kv_offset):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip tiles strictly above the causal diagonal (their mask is all -inf)
+    diag_visible = ((q_offset + (qi + 1) * block_q - 1)
+                    >= (kv_offset + ki * block_k)) if causal else True
+
+    @pl.when(diag_visible)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)            # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)            # (BK, D)
+        v = v_ref[0].astype(jnp.float32)            # (BK, D)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (BQ, BK)
+        if causal:
+            logits = _causal_mask(logits, qi, ki, block_q, block_k,
+                                  q_offset, kv_offset)
+        m_prev = m_scr[...]                          # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(logits, -1, keepdims=True))
+        # fully-masked rows inside a visible tile: m_new == -1e30, and
+        # exp(logits - m_new) would be exp(0) = 1 per masked entry —
+        # shift by 0 instead so those p rows underflow to exactly 0
+        safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(logits - safe_m)                 # (BQ, BK)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, -1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l = l_scr[...]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_scr[...] + jnp.log(l_safe), _NEG_INF)
+        lse_ref[0] = lse.astype(lse_ref.dtype)   # (BQ, 1)
+
+
+def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
+              kv_offset, interpret):
+    """q: (BH, Tq, D), k/v: (BH, Tk, D) -> (out (BH,Tq,D), lse (BH,Tq))."""
+    _require_tpu_helpers()
+    bh, tq, d = q.shape
+    tk = k.shape[1]
     kernel = functools.partial(
-        _kernel, scale=scale, causal=causal, block_q=block_q)
-    out = pl.pallas_call(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse rides as (BH, T, 1): mosaic wants last-two block dims
+            # (8k, 128k) or full-dim, which (block_q, 1) satisfies
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[_VMEM((block_q, 1), jnp.float32),
+                        _VMEM((block_q, 1), jnp.float32),
+                        _VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        **_compiler_params(interpret),
+    )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    interpret=False):
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k, q_offset,
+               kv_offset):
+    """Grid (bh, q_blocks, kv_blocks): accumulate dq over kv.
+
+    dl_ref carries ``g_lse - delta`` per row (combined outside)."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    diag_visible = ((q_offset + (qi + 1) * block_q - 1)
+                    >= (kv_offset + ki * block_k)) if causal else True
+
+    @pl.when(diag_visible)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = _causal_mask(logits, qi, ki, block_q, block_k,
+                                  q_offset, kv_offset)
+        # dead rows carry lse == -1e30; exp(logits - lse) would be 1
+        safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)
+        p = jnp.exp(logits - safe_lse)               # (BQ, BK)
+        dov = jax.lax.dot_general(                   # dO V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dov + dl_ref[0].astype(jnp.float32))
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
+                block_k, q_offset, kv_offset):
+    """Grid (bh, kv_blocks, q_blocks): accumulate dk/dv over q."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    diag_visible = ((q_offset + (qi + 1) * block_q - 1)
+                    >= (kv_offset + ki * block_k)) if causal else True
+
+    @pl.when(diag_visible)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = _causal_mask(logits, qi, ki, block_q, block_k,
+                                  q_offset, kv_offset)
+        safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)  # dead rows
+        p = jnp.exp(logits - safe_lse)               # (BQ, BK)
+        dv_scr[...] += jax.lax.dot_general(          # P^T dO
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dov = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dov + dl_ref[0].astype(jnp.float32))
+        dk_scr[...] += scale * jax.lax.dot_general(  # dS^T Q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
+              q_offset, kv_offset, interpret):
+    """lse/dl: (BH, Tq, 1) float32."""
+    _require_tpu_helpers()
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    qrow = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[_VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(q, k, v, do, lse, dl)
+    # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    qrow2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qrow2, qrow2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)],
+        scratch_shapes=[_VMEM((block_k, d), jnp.float32),
+                        _VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(q, k, v, do, lse, dl)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp core on (BH, T, D) layout, returning (out, lse)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, q_offset,
+                kv_offset, interpret):
+    out, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k,
+                         q_offset, kv_offset, interpret)
+    return out, lse
+
+
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, q_offset,
+                    kv_offset, interpret):
+    out, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k,
+                         q_offset, kv_offset, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, scale, block_q, block_k, q_offset, kv_offset,
+                    interpret, res, cts):
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    g_out32 = g_out.astype(jnp.float32)
+    delta = jnp.sum(g_out32 * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)                           # (BH, T, 1)
+    g_lse = (jnp.zeros_like(delta) if g_lse is None
+             else g_lse.astype(jnp.float32))
+    dl = g_lse - delta
+    dq, dk, dv = _bwd_call(q, k, v, g_out, lse, dl, causal, scale,
+                           block_q, block_k, q_offset, kv_offset, interpret)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API on (B, T, H, D) layout
+# ---------------------------------------------------------------------------
+def _to_bh(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=512, block_k=1024, q_offset=0,
+                             kv_offset=0, interpret=False):
+    """q,k,v: (B, T, H, D) -> (out (B,T,H,D), lse (B,H,T) float32).
+
+    Falls back to the jnp reference when T doesn't tile evenly (rare;
+    tests and ragged tails).  Offsets shift the *global* positions of the
+    local q / kv blocks for causal masking under sequence parallelism.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = (d ** -0.5) if scale is None else scale
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    if tq % bq or tk % bk:
+        return _ref_with_lse(q, k, v, causal=causal, scale=scale,
+                             q_offset=q_offset, kv_offset=kv_offset)
+    out, lse = _flash_core(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,
+                           bq, bk, int(q_offset), int(kv_offset), interpret)
+    return _from_bh(out, b, h), lse.reshape(b, h, tq)  # lse (BH, T, 1)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=1024, interpret=False):
     """Pallas attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
-    return _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret)
+    out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
+    return out
 
 
-def _fwd(q, k, v, causal, scale, block_q, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret)
-    return out, (q, k, v)
+def attention_auto(q, k, v, causal=False, scale=None, block_q=512,
+                   block_k=1024):
+    """Backend-dispatching attention: Pallas kernel on TPU, jnp reference
+    elsewhere.  Decided at trace time via ``jax.default_backend()`` so it
+    works under jit/shard_map (tracers carry no device info)."""
+    if use_pallas():
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    from dist_keras_tpu.ops.attention import attention
 
-
-def _bwd(causal, scale, block_q, interpret, res, g):
-    q, k, v = res
-    # recompute-based backward through the jnp reference (XLA fuses it)
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference_attention(
-            q, k, v, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_fwd, _bwd)
-
-
-def attention_auto(q, k, v, causal=False, scale=None, block_q=128):
-    """Backend-dispatching attention: pallas kernel on TPU, interpreted
-    kernel elsewhere only when tiny, else the jnp reference."""
-    platform = q.devices().pop().platform if hasattr(q, "devices") else None
-    if platform == "tpu" or platform == "axon":
-        return flash_attention(q, k, v, causal, scale, block_q)
-    return _reference_attention(q, k, v, causal=causal, scale=scale)
+    return attention(q, k, v, causal=causal, scale=scale)
