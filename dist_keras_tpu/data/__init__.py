@@ -6,6 +6,14 @@ from dist_keras_tpu.data.evaluators import (
     LossEvaluator,
 )
 from dist_keras_tpu.data.predictors import ModelPredictor, Predictor
+from dist_keras_tpu.data.streaming import (
+    KafkaSource,
+    QueueSource,
+    SocketSource,
+    StreamingPredictor,
+    StreamSource,
+    send_rows,
+)
 from dist_keras_tpu.data.transformers import (
     DenseTransformer,
     LabelIndexTransformer,
@@ -23,4 +31,6 @@ __all__ = [
     "StandardScaleTransformer",
     "Predictor", "ModelPredictor",
     "Evaluator", "AccuracyEvaluator", "LossEvaluator", "AUCEvaluator",
+    "StreamSource", "QueueSource", "SocketSource", "KafkaSource",
+    "StreamingPredictor", "send_rows",
 ]

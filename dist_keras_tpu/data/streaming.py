@@ -1,0 +1,263 @@
+"""Streaming inference — the Kafka/Spark-Streaming pipeline counterpart.
+
+The reference ships ``examples/kafka_producer.py`` plus a Spark Streaming
+notebook (SURVEY.md §2.4): rows arrive on a Kafka topic, Spark micro-batches
+them, and a trained Keras model appends predictions to each micro-batch.
+TPU-native re-design:
+
+- ``StreamSource`` — a pull iterator of feature rows.  Implementations:
+  ``QueueSource`` (in-process; the test/local stand-in for a topic),
+  ``SocketSource`` (length-prefixed JSON rows over TCP — the reference's
+  own wire-layer flavour, stdlib-only), and ``KafkaSource`` (gated import:
+  the image has no kafka client; raises with instructions if absent).
+- ``StreamingPredictor`` — micro-batching exactly like Spark Streaming,
+  but TPU-first: rows are packed into **fixed-shape** device batches
+  (padded, pad stripped after) so ONE jitted executable serves the whole
+  stream — no retraces, the MXU sees the same program every tick.  A
+  ``max_latency_s`` bound flushes partial batches so a trickling topic
+  still gets timely predictions.
+
+Use ``predict_stream`` as a generator of (features, predictions) ticks, or
+``run(source, sink)`` to push batches at a callback.  See
+``examples/streaming_inference.py`` for the producer/consumer pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dist_keras_tpu.data.predictors import Predictor
+from dist_keras_tpu.utils.serialization import deserialize_model
+
+_SENTINEL = object()
+
+
+class StreamSource:
+    """Pull interface: ``get(timeout) -> row | None`` (None = nothing yet),
+    ``closed`` property ends the stream."""
+
+    def get(self, timeout):
+        raise NotImplementedError
+
+    @property
+    def closed(self):
+        raise NotImplementedError
+
+
+class QueueSource(StreamSource):
+    """In-process source backed by ``queue.Queue`` — the local stand-in
+    for a Kafka topic (the reference's kafka_producer pushes rows the same
+    way).  Producers call ``put(row)`` / ``close()``."""
+
+    def __init__(self, maxsize=0):
+        self._q = queue.Queue(maxsize=maxsize)
+        self._closed = False
+
+    def put(self, row):
+        if self._closed:
+            raise ValueError("source is closed")
+        self._q.put(np.asarray(row, dtype=np.float32))
+
+    def close(self):
+        if self._closed:  # idempotent: a second sentinel would make
+            return        # `closed` (qsize <= 1) unreachable forever
+        self._closed = True
+        self._q.put(_SENTINEL)
+
+    def get(self, timeout):
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is _SENTINEL:
+            self._q.put(_SENTINEL)  # keep draining consumers unblocked
+            return None
+        return item
+
+    @property
+    def closed(self):
+        return self._closed and self._q.qsize() <= 1
+
+
+class SocketSource(StreamSource):
+    """Rows as length-prefixed JSON arrays over TCP (4-byte big-endian
+    length + utf-8 JSON list — the reference's networking.py framing, with
+    JSON instead of pickle for safety).
+
+    Producers connect sequentially (one at a time, like partitioned Kafka
+    consumers); a plain disconnect ends that producer and the accept loop
+    waits for the next, while an explicit empty frame (length 0) is the
+    END-OF-STREAM marker that closes the whole source.  The loop runs on a
+    daemon thread feeding an internal queue, so ``get`` has the same
+    semantics as QueueSource.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, backlog=4):
+        self._inner = QueueSource()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(backlog)
+        self.address = self._srv.getsockname()  # (host, bound port)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        try:
+            end_of_stream = False
+            while not end_of_stream:
+                conn, _ = self._srv.accept()
+                with conn:
+                    while True:
+                        hdr = _recvall(conn, 4)
+                        if hdr is None:
+                            break  # producer disconnected; accept next
+                        (n,) = struct.unpack(">I", hdr)
+                        if n == 0:
+                            end_of_stream = True
+                            break
+                        payload = _recvall(conn, n)
+                        if payload is None:
+                            break
+                        self._inner.put(
+                            json.loads(payload.decode("utf-8")))
+        finally:
+            self._inner.close()
+            self._srv.close()
+
+    def get(self, timeout):
+        return self._inner.get(timeout)
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+
+def _recvall(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def send_rows(address, rows, close=True):
+    """Producer helper: stream rows to a ``SocketSource`` (the
+    kafka_producer.py role).  ``rows``: iterable of 1-D feature arrays."""
+    with socket.create_connection(address) as conn:
+        for row in rows:
+            payload = json.dumps(
+                np.asarray(row, dtype=np.float32).tolist()).encode("utf-8")
+            conn.sendall(struct.pack(">I", len(payload)) + payload)
+        if close:
+            conn.sendall(struct.pack(">I", 0))
+
+
+class KafkaSource(StreamSource):
+    """Kafka topic source (gated: the TPU image bakes no kafka client)."""
+
+    def __init__(self, topic, value_deserializer=None, **consumer_kw):
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:  # pragma: no cover - no kafka in image
+            raise ImportError(
+                "KafkaSource needs the kafka-python package, which is not "
+                "baked into this image; use SocketSource/QueueSource, or "
+                "install kafka-python in your own environment.") from e
+        de = value_deserializer or (
+            lambda b: np.asarray(json.loads(b.decode("utf-8")), np.float32))
+        self._consumer = KafkaConsumer(
+            topic, value_deserializer=de, **consumer_kw)
+        self._closed = False
+
+    def get(self, timeout):  # pragma: no cover - no kafka in image
+        recs = self._consumer.poll(timeout_ms=int(timeout * 1000),
+                                   max_records=1)
+        for batch in recs.values():
+            for rec in batch:
+                return rec.value
+        return None
+
+    @property
+    def closed(self):  # pragma: no cover
+        return self._closed
+
+    def close(self):  # pragma: no cover
+        self._closed = True
+        self._consumer.close()
+
+
+class StreamingPredictor(Predictor):
+    """Micro-batching streaming inference with one fixed-shape executable.
+
+    Mirrors the reference's Spark-Streaming pipeline role: predictions for
+    rows arriving on a source, in arrival order.  ``batch_size`` rows are
+    packed per device dispatch; a partial batch is flushed after
+    ``max_latency_s`` (padded to the fixed shape, pad stripped from the
+    output), so shape-stability — and therefore zero retraces — holds for
+    the whole stream.
+    """
+
+    def __init__(self, keras_model, batch_size=256, max_latency_s=0.05,
+                 poll_timeout_s=0.01):
+        super().__init__(keras_model)  # serialized-model round-trip
+        self.batch_size = int(batch_size)
+        self.max_latency_s = float(max_latency_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        model = deserialize_model(self.serialized)
+        params = model.params
+        apply_fn = model.apply
+        self._predict = jax.jit(lambda x: apply_fn(params, x))
+
+    def predict_stream(self, source):
+        """-> generator of (rows (n, F), predictions (n, C)) micro-batches."""
+        pending = []
+        deadline = None
+        while True:
+            row = source.get(self.poll_timeout_s)
+            now = time.monotonic()
+            if row is not None:
+                pending.append(np.asarray(row, dtype=np.float32))
+                if deadline is None:
+                    deadline = now + self.max_latency_s
+            flush = (len(pending) >= self.batch_size
+                     or (pending and deadline is not None
+                         and now >= deadline)
+                     or (pending and source.closed))
+            if flush:
+                n = min(len(pending), self.batch_size)
+                chunk, pending = pending[:n], pending[n:]
+                deadline = (time.monotonic() + self.max_latency_s
+                            if pending else None)
+                x = np.stack(chunk)
+                pad = self.batch_size - n
+                if pad:
+                    x = np.concatenate(
+                        [x, np.repeat(x[-1:], pad, axis=0)])
+                preds = np.asarray(self._predict(jnp.asarray(x)))[:n]
+                yield x[:n], preds
+            elif not pending and source.closed:
+                return
+
+    def run(self, source, sink, max_batches=None):
+        """Push mode: ``sink(rows, predictions)`` per micro-batch.
+        Returns the number of rows predicted."""
+        total = 0
+        for i, (rows, preds) in enumerate(self.predict_stream(source)):
+            sink(rows, preds)
+            total += len(rows)
+            if max_batches is not None and i + 1 >= max_batches:
+                break
+        return total

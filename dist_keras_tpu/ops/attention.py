@@ -148,4 +148,6 @@ def ring_attention(q, k, v, axis=SEQ_AXIS, causal=False, scale=None,
         return o, lse, k, v
 
     o, lse, k, v = lax.fori_loop(1, n, ring_step, (o, lse, k, v))
-    return o
+    # merges accumulate through float32 lse weights; restore the input
+    # dtype so ring output matches the non-ring attn_fn contract
+    return o.astype(q.dtype)

@@ -345,6 +345,17 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 # ---------------------------------------------------------------------------
 # public API on (B, T, H, D) layout
 # ---------------------------------------------------------------------------
+def _fit_block(t, want):
+    """Largest block <= ``want`` that tiles ``t`` evenly and satisfies
+    mosaic's sublane rule (multiple of 8, or the full dimension).  None if
+    no such block exists — e.g. T=768 with want=512 picks 256 instead of
+    silently falling back to the O(T^2) jnp reference."""
+    for b in range(min(want, t), 7, -1):
+        if t % b == 0 and (b % 8 == 0 or b == t):
+            return b
+    return t if t < 8 else None
+
+
 def _to_bh(x):
     b, t, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
@@ -367,9 +378,9 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = (d ** -0.5) if scale is None else scale
-    bq = min(block_q, tq)
-    bk = min(block_k, tk)
-    if tq % bq or tk % bk:
+    bq = _fit_block(tq, block_q)
+    bk = _fit_block(tk, block_k)
+    if bq is None or bk is None:
         return _ref_with_lse(q, k, v, causal=causal, scale=scale,
                              q_offset=q_offset, kv_offset=kv_offset)
     out, lse = _flash_core(_to_bh(q), _to_bh(k), _to_bh(v), causal, scale,

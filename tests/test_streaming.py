@@ -1,0 +1,135 @@
+"""Streaming inference (data/streaming.py) — the Kafka-pipeline
+counterpart (reference examples/kafka_producer.py + streaming notebook,
+SURVEY.md §2.4)."""
+
+import threading
+
+import numpy as np
+
+from dist_keras_tpu.data import (
+    Dataset,
+    ModelPredictor,
+    QueueSource,
+    SocketSource,
+    StreamingPredictor,
+    send_rows,
+)
+from dist_keras_tpu.models import mnist_mlp
+
+
+def _model(input_dim=8, classes=3):
+    return mnist_mlp(hidden=(16,), input_dim=input_dim, num_classes=classes)
+
+
+def _rows(n=50, d=8, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+def test_queue_stream_matches_batch_predictor():
+    model = _model()
+    rows = _rows(50)
+    src = QueueSource()
+    for r in rows:
+        src.put(r)
+    src.close()
+
+    pred = StreamingPredictor(model, batch_size=16, max_latency_s=0.01)
+    got_rows, got_preds = [], []
+    for x, p in pred.predict_stream(src):
+        got_rows.append(x)
+        got_preds.append(p)
+    got_rows = np.concatenate(got_rows)
+    got_preds = np.concatenate(got_preds)
+
+    assert got_rows.shape == rows.shape
+    np.testing.assert_allclose(got_rows, rows, atol=1e-6)  # arrival order
+
+    # identical numbers to the batch ModelPredictor on the same rows
+    ds = Dataset({"features": rows, "label": np.zeros(len(rows))})
+    want = ModelPredictor(model, features_col="features").predict(
+        ds)["prediction"]
+    np.testing.assert_allclose(got_preds, np.asarray(want), atol=1e-5)
+
+
+def test_partial_batch_flush_and_padding():
+    """37 rows with batch 16 -> micro-batches 16, 16, 5; the padded tail
+    must strip its pad."""
+    model = _model()
+    rows = _rows(37)
+    src = QueueSource()
+    for r in rows:
+        src.put(r)
+    src.close()
+    pred = StreamingPredictor(model, batch_size=16, max_latency_s=0.01)
+    sizes = [len(x) for x, _ in pred.predict_stream(src)]
+    assert sizes == [16, 16, 5]
+
+
+def test_run_sink_and_max_batches():
+    model = _model()
+    src = QueueSource()
+    for r in _rows(40):
+        src.put(r)
+    src.close()
+    pred = StreamingPredictor(model, batch_size=8, max_latency_s=0.01)
+    seen = []
+    total = pred.run(src, lambda x, p: seen.append(len(x)), max_batches=3)
+    assert total == 24 and seen == [8, 8, 8]
+
+
+def test_socket_source_pipeline():
+    """Producer thread -> TCP framing -> streaming predictions, in order."""
+    model = _model()
+    rows = _rows(23)
+    src = SocketSource()
+    producer = threading.Thread(target=send_rows,
+                                args=(src.address, rows), daemon=True)
+    producer.start()
+    pred = StreamingPredictor(model, batch_size=8, max_latency_s=0.05)
+    got = np.concatenate([x for x, _ in pred.predict_stream(src)])
+    producer.join(timeout=5)
+    np.testing.assert_allclose(got, rows, atol=1e-6)
+
+
+def test_latency_flush_without_close():
+    """A trickle (fewer rows than batch_size, source still open) must
+    flush on the latency bound, not hang."""
+    model = _model()
+    src = QueueSource()
+    for r in _rows(3):
+        src.put(r)
+    pred = StreamingPredictor(model, batch_size=16, max_latency_s=0.05)
+    it = pred.predict_stream(src)
+    x, p = next(it)  # must arrive despite no close() and no full batch
+    assert len(x) == 3
+    src.close()
+
+
+def test_socket_source_sequential_producers():
+    """A producer disconnecting WITHOUT the end-of-stream frame hands off
+    to the next producer; only the empty frame closes the source."""
+    model = _model()
+    rows_a, rows_b = _rows(10, seed=1), _rows(10, seed=2)
+    src = SocketSource()
+
+    def produce():
+        send_rows(src.address, rows_a, close=False)   # plain disconnect
+        send_rows(src.address, rows_b, close=True)    # end-of-stream
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    pred = StreamingPredictor(model, batch_size=8, max_latency_s=0.05)
+    got = np.concatenate([x for x, _ in pred.predict_stream(src)])
+    t.join(timeout=5)
+    np.testing.assert_allclose(got, np.concatenate([rows_a, rows_b]),
+                               atol=1e-6)
+
+
+def test_queue_close_idempotent():
+    src = QueueSource()
+    src.put(np.zeros(4))
+    src.close()
+    src.close()  # second close must not wedge `closed`
+    assert src.get(0.01) is not None
+    assert src.get(0.01) is None
+    assert src.closed
