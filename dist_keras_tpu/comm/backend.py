@@ -53,9 +53,21 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         # single-process mode: nothing to do
         _initialized = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id, **kw)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kw)
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "multi-host bring-up came too late: something already "
+                "initialised the XLA backend (model construction, "
+                "jax.devices(), ...). Call dist_keras_tpu.comm.initialize() "
+                "as the FIRST thing in your pod entrypoint — before "
+                "building models or trainers (launch.Job exports the JAX_* "
+                "env; see tests/test_multihost.py's worker for the "
+                "pattern).") from e
+        raise
     _initialized = True
 
 
@@ -108,9 +120,13 @@ def fetch_global(tree):
     non-addressable ones fetched via allgather under the hood of
     ``jax.experimental.multihost_utils`` when multi-host.
     """
-    if is_multi_host():  # pragma: no cover - needs real multi-host
+    if is_multi_host():
         from jax.experimental import multihost_utils
 
+        # tiled=True: global sharded arrays concatenate along their
+        # existing axes (the only mode jax supports for non-fully-
+        # addressable inputs); host-local values gather equivalently
         return jax.tree.map(
-            multihost_utils.process_allgather, tree)
+            lambda x: multihost_utils.process_allgather(x, tiled=True),
+            tree)
     return jax.tree.map(np.asarray, tree)

@@ -147,7 +147,7 @@ class Dataset:
         return xb, yb
 
     def worker_shards(self, num_workers, batch_size, features_col="features",
-                      label_col="label", pad=True):
+                      label_col="label", pad=True, worker_range=None):
         """-> (num_workers, steps, batch, ...) arrays for shard_map training.
 
         Rows are dealt to workers contiguously (the reference's repartition
@@ -155,17 +155,24 @@ class Dataset:
         gets the same step count (lockstep SPMD needs rectangular data); with
         ``pad`` the tail shard is padded by wrapping around, mirroring how
         Spark balances partitions only approximately.
+
+        ``worker_range=(lo, hi)`` materializes ONLY workers [lo, hi) —
+        the multi-host path: every host computes the identical global
+        geometry from the full length, then slices its own workers' rows,
+        so concatenating hosts' results equals the full deal.
         """
-        x = np.asarray(self._cols[features_col], dtype=np.float32)
-        y = np.asarray(self._cols[label_col], dtype=np.float32)
+        x = self._cols[features_col]
+        y = self._cols[label_col]
         per = len(x) // num_workers
         steps = per // batch_size
         if steps == 0:
             raise ValueError(
                 f"{len(x)} rows over {num_workers} workers x batch "
                 f"{batch_size}: no full step")
-        need = num_workers * steps * batch_size
-        x, y = x[:need], y[:need]
-        xs = x.reshape(num_workers, steps, batch_size, *x.shape[1:])
-        ys = y.reshape(num_workers, steps, batch_size, *y.shape[1:])
+        lo, hi = (0, num_workers) if worker_range is None else worker_range
+        rows = slice(lo * steps * batch_size, hi * steps * batch_size)
+        x = np.asarray(x[rows], dtype=np.float32)
+        y = np.asarray(y[rows], dtype=np.float32)
+        xs = x.reshape(hi - lo, steps, batch_size, *x.shape[1:])
+        ys = y.reshape(hi - lo, steps, batch_size, *y.shape[1:])
         return xs, ys

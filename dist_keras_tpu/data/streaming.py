@@ -109,6 +109,8 @@ class SocketSource(StreamSource):
         self._srv.bind((host, port))
         self._srv.listen(backlog)
         self.address = self._srv.getsockname()  # (host, bound port)
+        self.error = None      # serve-thread failure, re-raised in get()
+        self._shutdown = False
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -116,7 +118,10 @@ class SocketSource(StreamSource):
         try:
             end_of_stream = False
             while not end_of_stream:
-                conn, _ = self._srv.accept()
+                try:
+                    conn, _ = self._srv.accept()
+                except OSError:
+                    break  # close() shut the listener down
                 with conn:
                     while True:
                         hdr = _recvall(conn, 4)
@@ -131,11 +136,27 @@ class SocketSource(StreamSource):
                             break
                         self._inner.put(
                             json.loads(payload.decode("utf-8")))
+        except Exception as e:  # surface to the consumer, never a
+            self.error = e      # silent clean end-of-stream
         finally:
             self._inner.close()
             self._srv.close()
 
+    def close(self):
+        """Consumer-side shutdown: stop accepting, end the stream (the
+        only way to terminate when a producer died before its
+        end-of-stream frame)."""
+        self._shutdown = True
+        try:
+            self._srv.close()  # unblocks accept() with OSError
+        except OSError:  # pragma: no cover
+            pass
+        self._inner.close()
+
     def get(self, timeout):
+        if self.error is not None:
+            raise RuntimeError(
+                "SocketSource producer stream failed") from self.error
         return self._inner.get(timeout)
 
     @property

@@ -92,16 +92,27 @@ class Job:
                 f"{host}:{self._remote_dir()}/"])
         return rc
 
+    def host_env(self, pid):
+        """The jax.distributed environment exported on host ``pid`` —
+        exactly the variables ``comm.initialize`` consumes
+        (comm/backend.py:30)."""
+        if not self.hosts:
+            raise ValueError("Job needs at least one host")
+        return {
+            "JAX_COORDINATOR_ADDRESS":
+                f"{self.hosts[0]}:{self.coordinator_port}",
+            "JAX_NUM_PROCESSES": str(self.num_processes),
+            "JAX_PROCESS_ID": str(pid),
+        }
+
     def launch(self):
         """Start the entrypoint on every host under jax.distributed env."""
         if not self.hosts:
             raise ValueError("Job needs at least one host to launch")
-        coordinator = f"{self.hosts[0]}:{self.coordinator_port}"
         rc = 0
         for pid, host in enumerate(self.hosts):
-            env = (f"JAX_COORDINATOR_ADDRESS={shlex.quote(coordinator)} "
-                   f"JAX_NUM_PROCESSES={self.num_processes} "
-                   f"JAX_PROCESS_ID={pid}")
+            env = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in self.host_env(pid).items())
             # every manifest-sourced field is quoted before it reaches the
             # remote shell (Punchcard manifests are user-editable JSON)
             # python may be a multi-word command ("python3 -u"): split it,

@@ -348,7 +348,7 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 def _fit_block(t, want):
     """Largest block <= ``want`` that tiles ``t`` evenly and satisfies
     mosaic's sublane rule (multiple of 8, or the full dimension).  None if
-    no such block exists — e.g. T=768 with want=512 picks 256 instead of
+    no such block exists — e.g. T=768 with want=512 picks 384 instead of
     silently falling back to the O(T^2) jnp reference."""
     for b in range(min(want, t), 7, -1):
         if t % b == 0 and (b % 8 == 0 or b == t):

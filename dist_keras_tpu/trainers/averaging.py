@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from dist_keras_tpu.parallel.collectives import tree_pmean_sync, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.step import make_model_step
 
@@ -79,8 +80,8 @@ class AveragingTrainer(DistributedTrainer):
         if restored is not None:
             params = restored["params"]
 
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
+        xs = self._to_device(xs)
+        ys = self._to_device(ys)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -94,7 +95,7 @@ class AveragingTrainer(DistributedTrainer):
             jax.block_until_ready(params)
             dt = _time.time() - t0
             epochs_done += E
-            losses = np.asarray(losses)  # (workers, E, steps)
+            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)
             all_losses.append(losses)
             self._emit_epoch_end(epochs_done, losses, dt,
                                  samples_per_epoch * E)
@@ -164,8 +165,8 @@ class EnsembleTrainer(DistributedTrainer):
             stacked = restored["params"]
             opt_state = restored["opt_state"]
 
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
+        xs = self._to_device(xs)
+        ys = self._to_device(ys)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -180,7 +181,7 @@ class EnsembleTrainer(DistributedTrainer):
             jax.block_until_ready(stacked)
             dt = _time.time() - t0
             epochs_done += E
-            losses = np.asarray(losses)
+            losses = np.asarray(comm.fetch_global(losses))
             all_losses.append(losses)
             self._emit_epoch_end(epochs_done, losses, dt,
                                  samples_per_epoch * E)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dist_keras_tpu.ops.losses import get_loss
@@ -244,13 +245,76 @@ class DistributedTrainer(Trainer):
     @property
     def mesh(self):
         if self._mesh is None:
+            from dist_keras_tpu.comm import backend as comm
+
+            # multi-host bring-up: no-op single-process; on a pod it reads
+            # the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+            # JAX_PROCESS_ID env that launch.Job exports per host
+            comm.initialize()
             self._mesh = worker_mesh(self.num_workers)
         return self._mesh
 
+    def _local_worker_range(self):
+        """[lo, hi) worker-mesh slots whose device lives on this process.
+
+        jax.devices() orders devices by process, so a 1-D worker mesh
+        gives every host a contiguous run of workers."""
+        import jax as _jax
+
+        devs = list(self.mesh.devices.ravel())
+        mine = [i for i, d in enumerate(devs)
+                if d.process_index == _jax.process_index()]
+        if not mine:
+            return 0, 0
+        lo, hi = mine[0], mine[-1] + 1
+        if mine != list(range(lo, hi)):  # pragma: no cover - defensive
+            raise RuntimeError(
+                "non-contiguous local worker slots; pass an explicit mesh")
+        return lo, hi
+
     def _shards(self, dataset):
+        """-> (xs, ys) host arrays with a leading worker axis.
+
+        Single-process: the full (num_workers, steps, batch, ...) deal.
+        Multi-host: ONLY this host's workers' rows are materialized
+        (leading axis = local worker count); every host computes the
+        identical global geometry from the dataset length, so the
+        concatenation over hosts equals the single-host deal.  Feed the
+        result through ``_to_device`` to get the global sharded array.
+        The reference analogue is Spark shipping each executor only its
+        partitions (trainers.py:~365) — via ``comm.local_data_slice``
+        semantics (comm/backend.py).
+        """
+        from dist_keras_tpu.comm import backend as comm
+
+        _ = self.mesh  # force process-group bring-up (informative error
+        # if comm.initialize() was forgotten at program start)
+        if not comm.is_multi_host():
+            return dataset.worker_shards(
+                self.num_workers, self.batch_size,
+                features_col=self.features_col, label_col=self.label_col)
         return dataset.worker_shards(
             self.num_workers, self.batch_size,
-            features_col=self.features_col, label_col=self.label_col)
+            features_col=self.features_col, label_col=self.label_col,
+            worker_range=self._local_worker_range())
+
+    def _to_device(self, x):
+        """Host (local_workers, ...) array -> device array sharded over
+        the worker mesh axis; on multi-host the global array is assembled
+        from each process's local block without any host materializing
+        the global data."""
+        from dist_keras_tpu.comm import backend as comm
+
+        if not comm.is_multi_host():
+            return jnp.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(WORKER_AXIS)), x,
+            (self.num_workers,) + x.shape[1:])
 
     def _stack_workers(self, tree):
         """Replicate a pytree with a leading (num_workers,) axis — the
@@ -259,20 +323,32 @@ class DistributedTrainer(Trainer):
         over the worker mesh axis.
 
         The broadcast stays a zero-copy numpy view on the host and each
-        leaf is ``device_put`` directly with the worker sharding, so no
-        device ever holds more than its own (1, ...) shard — materializing
-        the full (workers, ...) stack on one chip could OOM where the
-        per-worker state fits fine."""
+        leaf is ``device_put`` (or process-local assembly on multi-host)
+        directly with the worker sharding, so no device ever holds more
+        than its own (1, ...) shard — materializing the full
+        (workers, ...) stack on one chip could OOM where the per-worker
+        state fits fine."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from dist_keras_tpu.comm import backend as comm
         from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 
         n = self.num_workers
         sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
 
-        def _stack(x):
-            x = np.asarray(x)
-            return jax.device_put(
-                np.broadcast_to(x[None], (n,) + x.shape), sharding)
+        if comm.is_multi_host():
+            lo, hi = self._local_worker_range()
+
+            def _stack(x):
+                x = np.asarray(x)
+                return jax.make_array_from_process_local_data(
+                    sharding,
+                    np.broadcast_to(x[None], (hi - lo,) + x.shape),
+                    (n,) + x.shape)
+        else:
+            def _stack(x):
+                x = np.asarray(x)
+                return jax.device_put(
+                    np.broadcast_to(x[None], (n,) + x.shape), sharding)
 
         return jax.tree.map(_stack, tree)

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import tree_merge_floats, tree_zeros_like
@@ -175,8 +176,8 @@ class DynSGD(DistributedTrainer):
             last_seen = restored["last_seen"]
             global_count = restored["global_count"]
 
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
+        xs = self._to_device(xs)
+        ys = self._to_device(ys)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -193,7 +194,7 @@ class DynSGD(DistributedTrainer):
             jax.block_until_ready(center)
             dt = _time.time() - t0
             epochs_done += E
-            losses = np.asarray(losses)  # (workers, E, steps)
+            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)
             all_losses.append(losses)
             self._emit_epoch_end(epochs_done, losses, dt,
                                  samples_per_epoch * E)

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import (
@@ -115,10 +116,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             warnings.warn(
                 f"dropping {dropped} trailing step(s) per worker per epoch "
                 f"(not a whole communication window)", stacklevel=2)
+        # leading axis is LOCAL workers (== num_workers single-process;
+        # this host's slice when multi-host, see base._shards)
         xs = xs[:, :windows * W].reshape(
-            self.num_workers, windows, W, *xs.shape[2:])
+            xs.shape[0], windows, W, *xs.shape[2:])
         ys = ys[:, :windows * W].reshape(
-            self.num_workers, windows, W, *ys.shape[2:])
+            ys.shape[0], windows, W, *ys.shape[2:])
 
         mesh = self.mesh
         merge = self.merge
@@ -183,8 +186,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             local = restored["local"]
             opt_state = restored["opt_state"]
 
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
+        xs = self._to_device(xs)
+        ys = self._to_device(ys)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = self.num_workers * windows * W * self.batch_size
 
@@ -200,7 +203,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             jax.block_until_ready(center)
             dt = _time.time() - t0
             epochs_done += E
-            losses = np.asarray(losses)  # (workers, E, windows, W)
+            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, windows, W)
             all_losses.append(losses)
             self._emit_epoch_end(epochs_done, losses, dt,
                                  samples_per_epoch * E)

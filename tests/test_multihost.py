@@ -1,0 +1,195 @@
+"""Multi-host consumption tests (VERDICT round-1 #7).
+
+Three levels:
+1. Pure-math: each host's ``_shards`` slice concatenates to exactly the
+   single-host worker deal (no host materializes global data).
+2. Env wiring: ``launch.Job``'s exported JAX_* variables drive
+   ``comm.initialize`` (monkeypatched ``jax.distributed.initialize``).
+3. Real 2-process ``jax.distributed`` over CPU (Gloo): ADAG trains the
+   same data on a 2-host x 4-device group and must produce the same
+   center weights as the single-process 8-device run.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. slicing math
+# ---------------------------------------------------------------------------
+def test_local_shards_concat_to_global_deal(monkeypatch, blobs_dataset):
+    from dist_keras_tpu.comm import backend as comm
+    from dist_keras_tpu.trainers import ADAG
+    from dist_keras_tpu.models import mnist_mlp
+
+    t = ADAG(mnist_mlp(hidden=(8,), input_dim=8, num_classes=2),
+             num_workers=8, batch_size=16, label_col="label_encoded")
+    want_x, want_y = t._shards(blobs_dataset)  # single-host deal
+
+    monkeypatch.setattr(comm, "is_multi_host", lambda: True)
+    got_x, got_y = [], []
+    for proc in range(2):
+        # each fake host sees only its contiguous worker range [lo, hi)
+        monkeypatch.setattr(
+            ADAG, "_local_worker_range",
+            lambda self, p=proc: (p * 4, (p + 1) * 4))
+        x, y = t._shards(blobs_dataset)
+        assert x.shape[0] == 4  # local workers only — not the global 8
+        got_x.append(x)
+        got_y.append(y)
+    np.testing.assert_array_equal(np.concatenate(got_x), want_x)
+    np.testing.assert_array_equal(np.concatenate(got_y), want_y)
+
+
+def test_local_data_slice_partitions_everything():
+    from dist_keras_tpu.comm.backend import local_data_slice
+
+    n = 1003
+    rows = []
+    for p in range(3):
+        lo, hi = local_data_slice(n, process=p, count=3)
+        rows.extend(range(lo, hi))
+    assert rows == list(range(n))  # disjoint, ordered, complete
+
+
+# ---------------------------------------------------------------------------
+# 2. launch.Job env wiring -> comm.initialize
+# ---------------------------------------------------------------------------
+def test_job_env_drives_comm_initialize(monkeypatch):
+    import jax
+
+    from dist_keras_tpu.comm import backend as comm
+    from dist_keras_tpu.launch.job import Job
+
+    job = Job(secret="s", job_name="t", job_dir=".", hosts=["h0", "h1"],
+              coordinator_port=9999, dry_run=True)
+    env = job.host_env(1)  # what Job.launch exports on host 1
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["JAX_COORDINATOR_ADDRESS"].endswith(":9999")
+
+    seen = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address=None, num_processes=None,
+        process_id=None, **kw: seen.update(
+            addr=coordinator_address, n=num_processes, pid=process_id))
+    monkeypatch.setattr(comm, "_initialized", False)
+    for k, vv in env.items():
+        if k.startswith("JAX_"):
+            monkeypatch.setenv(k, vv)
+    comm.initialize()
+    assert seen == {"addr": env["JAX_COORDINATOR_ADDRESS"],
+                    "n": 2, "pid": 1}
+    monkeypatch.setattr(comm, "_initialized", False)  # restore
+
+
+# ---------------------------------------------------------------------------
+# 3. real 2-process CPU group
+# ---------------------------------------------------------------------------
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:%PORT%"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
+
+import numpy as np
+sys.path.insert(0, %REPO%)
+# process-group bring-up must precede any XLA-touching call (model init);
+# this is the documented entrypoint pattern for launch.Job pods
+from dist_keras_tpu.comm import backend as comm
+comm.initialize()
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.trainers import ADAG
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+n, d = 512, 8
+y = rng.integers(0, 2, size=n)
+centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+x = centers[y] + rng.normal(size=(n, d)).astype(np.float32)
+ds = Dataset({"features": x.astype(np.float32),
+              "label_encoded": one_hot(y, 2), "label": y})
+
+t = ADAG(mnist_mlp(hidden=(16,), input_dim=8, num_classes=2),
+         num_workers=8, communication_window=4, worker_optimizer="sgd",
+         optimizer_kwargs={"learning_rate": 0.05}, batch_size=16,
+         num_epoch=2, label_col="label_encoded", seed=0)
+# trainer's mesh property calls comm.initialize() -> JAX_* env above
+model = t.train(ds)
+ws = model.get_weights()
+print("NPROC", jax.process_count(), flush=True)
+np.savez(%OUT% + f"_{pid}.npz", *ws)
+print("DONE", pid, flush=True)
+"""
+
+
+def test_two_process_adag_matches_single_process(tmp_path):
+    """The full ADAG trainer on a real 2-process CPU group: each host
+    feeds only its local workers, and the resulting center weights match
+    the single-process (8 local devices) run bitwise-closely."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    out = str(tmp_path / "w")
+    script = (_WORKER
+              .replace("%PORT%", str(port))
+              .replace("%REPO%", repr(REPO))
+              .replace("%OUT%", repr(out)))
+    path = tmp_path / "worker.py"
+    path.write_text(script)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")}
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen([sys.executable, str(path), str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for pid in (0, 1)]
+    outs = [p.communicate(timeout=540)[0] for p in procs]
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{o[-3000:]}"
+        assert "NPROC 2" in o, f"proc {pid} not multi-host:\n{o[-2000:]}"
+
+    # both hosts converged to the same center
+    w0 = np.load(out + "_0.npz")
+    w1 = np.load(out + "_1.npz")
+    for k in w0.files:
+        np.testing.assert_allclose(w0[k], w1[k], atol=1e-6)
+
+    # and that center matches the single-process 8-device run
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.trainers import ADAG
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    n, d = 512, 8
+    y = rng.integers(0, 2, size=n)
+    centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+    x = centers[y] + rng.normal(size=(n, d)).astype(np.float32)
+    ds = Dataset({"features": x.astype(np.float32),
+                  "label_encoded": one_hot(y, 2), "label": y})
+    t = ADAG(mnist_mlp(hidden=(16,), input_dim=8, num_classes=2),
+             num_workers=8, communication_window=4, worker_optimizer="sgd",
+             optimizer_kwargs={"learning_rate": 0.05}, batch_size=16,
+             num_epoch=2, label_col="label_encoded", seed=0)
+    ref = t.train(ds).get_weights()
+    for a, k in zip(ref, w0.files):
+        np.testing.assert_allclose(a, w0[k], atol=1e-5)

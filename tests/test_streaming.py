@@ -133,3 +133,39 @@ def test_queue_close_idempotent():
     assert src.get(0.01) is not None
     assert src.get(0.01) is None
     assert src.closed
+
+
+def test_socket_source_surfaces_producer_errors():
+    """A corrupt frame must raise on the consumer side, not truncate the
+    stream into a clean end-of-stream."""
+    import socket as socketlib
+    import struct
+    import time
+
+    src = SocketSource()
+    with socketlib.create_connection(src.address) as conn:
+        bad = b"not json"
+        conn.sendall(struct.pack(">I", len(bad)) + bad)
+        time.sleep(0.2)  # let the serve thread hit the decode error
+    try:
+        src.get(0.1)
+        raised = False
+    except RuntimeError as e:
+        raised = True
+        assert "producer stream failed" in str(e)
+    assert raised
+
+
+def test_socket_source_consumer_close():
+    """close() terminates a stream whose producer died without the
+    end-of-stream frame (no hang, no leaked listener)."""
+    model = _model()
+    rows = _rows(5)
+    src = SocketSource()
+    send_rows(src.address, rows, close=False)  # producer dies, no EOS
+    pred = StreamingPredictor(model, batch_size=8, max_latency_s=0.02)
+    it = pred.predict_stream(src)
+    x, _ = next(it)
+    assert len(x) == 5
+    src.close()  # consumer ends the stream
+    assert list(it) == []
