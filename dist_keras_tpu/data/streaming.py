@@ -136,8 +136,11 @@ class SocketSource(StreamSource):
                             break
                         self._inner.put(
                             json.loads(payload.decode("utf-8")))
-        except Exception as e:  # surface to the consumer, never a
-            self.error = e      # silent clean end-of-stream
+        except Exception as e:
+            if not self._shutdown:  # surface to the consumer, never a
+                self.error = e      # silent clean end-of-stream; but a
+                # consumer-initiated close() racing a producer is a clean
+                # shutdown, not a stream failure
         finally:
             self._inner.close()
             self._srv.close()

@@ -76,6 +76,17 @@ def _compiler_params(interpret):
         dimension_semantics=("parallel", "parallel", "arbitrary"))}
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes set, so the
+    kernels compose with shard_map(check_vma=True) — ring attention calls
+    them with the seq axis bound (vma is how jax tracks which mesh axes a
+    value varies over inside shard_map)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _causal_mask(logits, qi, ki, block_q, block_k, q_offset, kv_offset):
     qpos = (q_offset + qi * block_q
             + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0))
@@ -160,8 +171,8 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            _sds((bh, tq, d), q.dtype, q),
+            _sds((bh, tq, 1), jnp.float32, q),
         ],
         scratch_shapes=[_VMEM((block_q, 1), jnp.float32),
                         _VMEM((block_q, 1), jnp.float32),
@@ -282,7 +293,7 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=_sds((bh, tq, d), q.dtype, q),
         scratch_shapes=[_VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         **_compiler_params(interpret),
@@ -296,8 +307,8 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, qrow2, qrow2],
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)],
+        out_shape=[_sds((bh, tk, d), k.dtype, q),
+                   _sds((bh, tk, d), v.dtype, q)],
         scratch_shapes=[_VMEM((block_k, d), jnp.float32),
                         _VMEM((block_k, d), jnp.float32)],
         interpret=interpret,

@@ -4,6 +4,11 @@ from dist_keras_tpu.parallel.collectives import (
     tree_ppermute,
     tree_psum,
 )
+from dist_keras_tpu.parallel.fsdp import (
+    fsdp_specs,
+    make_fsdp_train_step,
+    train_fsdp,
+)
 from dist_keras_tpu.parallel.mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
@@ -15,4 +20,5 @@ from dist_keras_tpu.parallel.mesh import (
 __all__ = [
     "worker_mesh", "grid_mesh", "WORKER_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "tree_psum", "tree_pmean", "tree_all_gather", "tree_ppermute",
+    "fsdp_specs", "make_fsdp_train_step", "train_fsdp",
 ]

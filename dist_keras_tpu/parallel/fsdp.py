@@ -1,0 +1,138 @@
+"""FSDP / ZeRO-3-style fully-sharded training — new capability surface.
+
+The reference has no parameter sharding of any kind (SURVEY.md §2.3: every
+Spark worker holds the full model).  This module adds the TPU-idiomatic
+version for models that don't fit (or shouldn't be replicated) per device:
+parameters AND optimizer state live sharded across the ``workers`` mesh
+axis, and XLA's SPMD partitioner inserts the all-gathers before use and
+reduce-scatters after the backward — the "annotate shardings, let XLA
+insert collectives" recipe, deliberately contrasting with the hand-written
+``shard_map`` TP/SP step in ``transformer_tp.py``:
+
+- ``transformer_tp``: manual collectives, head/ff dims Megatron-split,
+  activations sequence-sharded — for when you want explicit control.
+- ``fsdp`` (here): zero model-code changes — the single-device
+  ``transformer_apply`` (or any model's ``apply``) runs unmodified under
+  ``jit`` with sharded ``in_shardings``; the compiler schedules the
+  parameter movement.  Batch is data-parallel over the same axis.
+
+``fsdp_specs`` shards each float leaf along its largest dimension that
+divides the axis size (leaves smaller than ``min_shard_elems`` stay
+replicated — gathering tiny tensors costs more than storing them).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+
+
+def fsdp_specs(params, axis_size, axis=WORKER_AXIS, min_shard_elems=2 ** 12):
+    """PartitionSpec pytree: shard each big-enough leaf on its largest
+    axis-divisible dimension; replicate the rest."""
+
+    def spec(leaf):
+        shape = np.shape(leaf)
+        if np.size(leaf) < min_shard_elems:
+            return P()
+        divisible = [d for d in range(len(shape))
+                     if shape[d] % axis_size == 0 and shape[d] >= axis_size]
+        if not divisible:
+            return P()
+        best = max(divisible, key=lambda d: shape[d])
+        parts = [None] * len(shape)
+        parts[best] = axis
+        return P(*parts)
+
+    return jax.tree.map(spec, params)
+
+
+def _shardings(mesh, specs):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
+                         axis=WORKER_AXIS, min_shard_elems=2 ** 12):
+    """-> (init_fn, step_fn) for fully-sharded data-parallel training.
+
+    ``apply_fn(params, x) -> logits``; ``loss_fn(logits, y) -> scalar``.
+
+    init_fn(params) -> (params, opt_state) placed sharded on the mesh.
+    step_fn(params, opt_state, x, y) -> (params, opt_state, loss); x/y are
+    batch-sharded over ``axis``; params/opt-state stay sharded across
+    steps (donated, so memory is the sharded footprint only).
+    """
+    tx = optimizer or optax.adam(1e-3)
+    axis_size = int(np.prod([mesh.shape[a] for a in (axis,)]))
+
+    def init_fn(params):
+        pspecs = fsdp_specs(params, axis_size, axis, min_shard_elems)
+        pshard = _shardings(mesh, pspecs)
+        params = jax.tree.map(jax.device_put, params, pshard)
+        opt_state = jax.jit(
+            tx.init,
+            out_shardings=_opt_shardings(params, pspecs, mesh))(params)
+        return params, opt_state
+
+    def _opt_shardings(params, pspecs, mesh_):
+        """Optimizer leaves mirror the param tree leaf-for-leaf (adam's
+        mu/nu); anything without a same-shape param replicates."""
+        shape_to_spec = {}
+        for arr, sp in zip(
+                jax.tree.leaves(params),
+                jax.tree.leaves(pspecs,
+                                is_leaf=lambda s: isinstance(s, P))):
+            shape_to_spec.setdefault(tuple(np.shape(arr)), sp)
+        template = tx.init(jax.eval_shape(lambda p: p, params))
+        return jax.tree.map(
+            lambda leaf: NamedSharding(
+                mesh_, shape_to_spec.get(tuple(np.shape(leaf)), P())),
+            template)
+
+    data_sharding = NamedSharding(mesh, P(axis))
+
+    def step(params, opt_state, x, y):
+        def loss_of(p):
+            return loss_fn(apply_fn(p, x), y)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def step_fn_factory(params, opt_state):
+        pshard = jax.tree.map(lambda a: a.sharding, params)
+        oshard = jax.tree.map(lambda a: a.sharding, opt_state)
+        return jax.jit(
+            step,
+            in_shardings=(pshard, oshard, data_sharding, data_sharding),
+            out_shardings=(pshard, oshard, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1),
+        )
+
+    return init_fn, step_fn_factory
+
+
+def train_fsdp(mesh, model_apply, loss_fn, params, x, y, steps=10,
+               optimizer=None):
+    """Convenience loop mirroring ``train_tp_transformer``: compile once,
+    run ``steps`` full-batch updates on sharded state."""
+    init_fn, factory = make_fsdp_train_step(
+        mesh, loss_fn, model_apply, optimizer=optimizer)
+    params, opt_state = init_fn(params)
+    fn = factory(params, opt_state)
+    xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(WORKER_AXIS)))
+    yd = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P(WORKER_AXIS)))
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = fn(params, opt_state, xd, yd)
+        losses.append(float(loss))
+    return params, losses

@@ -289,14 +289,11 @@ class DistributedTrainer(Trainer):
 
         _ = self.mesh  # force process-group bring-up (informative error
         # if comm.initialize() was forgotten at program start)
-        if not comm.is_multi_host():
-            return dataset.worker_shards(
-                self.num_workers, self.batch_size,
-                features_col=self.features_col, label_col=self.label_col)
         return dataset.worker_shards(
             self.num_workers, self.batch_size,
             features_col=self.features_col, label_col=self.label_col,
-            worker_range=self._local_worker_range())
+            worker_range=(self._local_worker_range()
+                          if comm.is_multi_host() else None))
 
     def _to_device(self, x):
         """Host (local_workers, ...) array -> device array sharded over
