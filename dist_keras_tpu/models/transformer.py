@@ -83,6 +83,21 @@ def layer_norm(p, x, eps=1e-5):
 _ln = layer_norm
 
 
+def apply_block(blk, h, attn_fn, causal):
+    """One pre-LN attention+FFN residual block — the single definition
+    shared by the oracle forward, the TP step, and the pipelined forward
+    (parallel/pipeline.py), so their math can never silently diverge."""
+    y = _ln(blk["ln1"], h)
+    q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
+    k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
+    v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
+    a = attn_fn(q, k, v, causal=causal)
+    h = h + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
+    y = _ln(blk["ln2"], h)
+    u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
+    return h + u @ blk["w2"] + blk["b2"]
+
+
 def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
     """Forward pass.  x: (B, T, input_dim) -> logits (B, n_classes).
 
@@ -98,15 +113,7 @@ def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
         attn_fn = attention_auto
     h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
     for blk in params["blocks"]:
-        y = _ln(blk["ln1"], h)
-        q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
-        k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
-        v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
-        a = attn_fn(q, k, v, causal=causal)
-        h = h + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
-        y = _ln(blk["ln2"], h)
-        u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
-        h = h + u @ blk["w2"] + blk["b2"]
+        h = apply_block(blk, h, attn_fn, causal)
     pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
     return pooled @ params["head"]["kernel"] + params["head"]["bias"]
 

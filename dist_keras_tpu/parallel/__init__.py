@@ -16,9 +16,25 @@ from dist_keras_tpu.parallel.mesh import (
     grid_mesh,
     worker_mesh,
 )
+from dist_keras_tpu.parallel.moe import (
+    EXPERT_AXIS,
+    init_moe_params,
+    moe_param_specs,
+    switch_moe_dense,
+    switch_moe_ep,
+)
+from dist_keras_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    gpipe_apply,
+    pp_transformer_apply,
+    stack_blocks,
+)
 
 __all__ = [
     "worker_mesh", "grid_mesh", "WORKER_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "tree_psum", "tree_pmean", "tree_all_gather", "tree_ppermute",
     "fsdp_specs", "make_fsdp_train_step", "train_fsdp",
+    "EXPERT_AXIS", "init_moe_params", "moe_param_specs",
+    "switch_moe_dense", "switch_moe_ep",
+    "PIPE_AXIS", "gpipe_apply", "pp_transformer_apply", "stack_blocks",
 ]

@@ -59,6 +59,27 @@ def _shardings(mesh, specs):
         is_leaf=lambda s: isinstance(s, P))
 
 
+def match_specs_by_shape(params, pspecs, tree):
+    """Spec pytree for ``tree``: each leaf inherits the spec of the param
+    with the same global shape (optimizer states mirror params
+    leaf-for-leaf); shapes without a param counterpart replicate.
+    Conflicting specs for one shape are ambiguous -> hard error.  Shared
+    by FSDP and the TP step (transformer_tp._opt_specs)."""
+    shape_to_spec = {}
+    for arr, sp in zip(
+            jax.tree.leaves(params),
+            jax.tree.leaves(pspecs, is_leaf=lambda s: isinstance(s, P))):
+        shape = tuple(np.shape(arr))
+        if shape in shape_to_spec and shape_to_spec[shape] != sp:
+            raise ValueError(
+                f"ambiguous sharding for shape {shape}: "
+                f"{shape_to_spec[shape]} vs {sp}; choose distinct "
+                "dimension sizes")
+        shape_to_spec[shape] = sp
+    return jax.tree.map(
+        lambda leaf: shape_to_spec.get(tuple(np.shape(leaf)), P()), tree)
+
+
 def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
                          axis=WORKER_AXIS, min_shard_elems=2 ** 12):
     """-> (init_fn, step_fn) for fully-sharded data-parallel training.
@@ -84,18 +105,15 @@ def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
 
     def _opt_shardings(params, pspecs, mesh_):
         """Optimizer leaves mirror the param tree leaf-for-leaf (adam's
-        mu/nu); anything without a same-shape param replicates."""
-        shape_to_spec = {}
-        for arr, sp in zip(
-                jax.tree.leaves(params),
-                jax.tree.leaves(pspecs,
-                                is_leaf=lambda s: isinstance(s, P))):
-            shape_to_spec.setdefault(tuple(np.shape(arr)), sp)
-        template = tx.init(jax.eval_shape(lambda p: p, params))
+        mu/nu); anything without a same-shape param replicates.
+        eval_shape(tx.init, ...) keeps this abstract — materializing the
+        full unsharded state would be the exact OOM FSDP exists to
+        avoid."""
+        template = jax.eval_shape(tx.init, params)
+        specs = match_specs_by_shape(params, pspecs, template)
         return jax.tree.map(
-            lambda leaf: NamedSharding(
-                mesh_, shape_to_spec.get(tuple(np.shape(leaf)), P())),
-            template)
+            lambda s: NamedSharding(mesh_, s), specs,
+            is_leaf=lambda s: isinstance(s, P))
 
     data_sharding = NamedSharding(mesh, P(axis))
 
@@ -122,11 +140,12 @@ def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
 
 
 def train_fsdp(mesh, model_apply, loss_fn, params, x, y, steps=10,
-               optimizer=None):
+               optimizer=None, min_shard_elems=2 ** 12):
     """Convenience loop mirroring ``train_tp_transformer``: compile once,
     run ``steps`` full-batch updates on sharded state."""
     init_fn, factory = make_fsdp_train_step(
-        mesh, loss_fn, model_apply, optimizer=optimizer)
+        mesh, loss_fn, model_apply, optimizer=optimizer,
+        min_shard_elems=min_shard_elems)
     params, opt_state = init_fn(params)
     fn = factory(params, opt_state)
     xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(WORKER_AXIS)))

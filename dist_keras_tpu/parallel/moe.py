@@ -1,0 +1,141 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP) — new capability.
+
+The reference has no MoE and no expert sharding (SURVEY.md §2.3: every
+parallelism beyond data-parallel is absent upstream).  This is the
+TPU-idiomatic Switch-Transformer-style layer:
+
+- **Routing**: top-1 (Switch) router with a capacity limit
+  ``C = ceil(tokens * capacity_factor / num_experts)`` per expert;
+  overflowing tokens pass through unprocessed (standard Switch drop
+  semantics — the residual connection carries them).
+- **Expert parallelism**: experts live sharded over the ``experts`` mesh
+  axis; tokens are dispatched to their expert's device with ONE
+  ``lax.all_to_all`` each way (the EP collective), and every expert
+  processes its global token queue as one batched matmul — MXU-friendly
+  (E_local, capacity*ep, d) x (d, ff) instead of ragged gathers.
+- **Oracle**: ``switch_moe_dense`` computes the same mixture without
+  dispatch (every expert on every device) for parity tests; with ample
+  capacity the EP output matches it exactly.
+
+Use inside ``shard_map`` with the ``experts`` axis bound (tokens
+data-sharded over the same axis), or single-device via ``ep=1``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_keras_tpu.models.layers import glorot_uniform
+
+EXPERT_AXIS = "experts"
+
+
+def init_moe_params(key, d_model, d_ff, num_experts):
+    """Router + per-expert FFN stacks.  Shard leaves' leading expert dim
+    over the ``experts`` mesh axis for EP (see ``moe_param_specs``)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "router": glorot_uniform(k1, (d_model, num_experts)),
+        "w1": glorot_uniform(k2, (num_experts, d_model, d_ff)),
+        "b1": jnp.zeros((num_experts, d_ff)),
+        "w2": glorot_uniform(k3, (num_experts, d_ff, d_model)),
+        "b2": jnp.zeros((num_experts, d_model)),
+    }
+
+
+def moe_param_specs(axis=EXPERT_AXIS):
+    """PartitionSpecs: experts sharded, router replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"router": P(), "w1": P(axis), "b1": P(axis),
+            "w2": P(axis), "b2": P(axis)}
+
+
+def _route(params, x, num_experts, capacity):
+    """-> (dispatch (N, E, C), combine (N, E, C), aux_loss scalar).
+
+    Top-1 routing with per-expert capacity; position in the expert queue
+    is assignment order (deterministic).  ``combine = dispatch * gate``.
+    The aux load-balancing loss is the Switch mean(frac_tokens *
+    frac_probs) * E.
+    """
+    n = x.shape[0]
+    logits = x @ params["router"]                      # (N, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = jnp.max(probs, axis=-1)                     # (N,)
+    expert = jnp.argmax(probs, axis=-1)                # (N,)
+    onehot = jax.nn.one_hot(expert, num_experts,
+                            dtype=jnp.float32)         # (N, E)
+    # queue position of each token within its chosen expert
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # (N, E), 0-based
+    keep = (pos < capacity) * onehot                    # (N, E)
+    posc = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)            # (N, C)
+    dispatch = keep[:, :, None] * posc[:, None, :]      # (N, E, C)
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: encourages uniform load
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = jnp.sum(frac_tokens * frac_probs) * num_experts
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w1, b1, w2, b2, xs, activation):
+    h = activation(jnp.einsum("ecd,edf->ecf", xs, w1) + b1[:, None])
+    return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None]
+
+
+def switch_moe_dense(params, x, capacity_factor=1.25,
+                     activation=jax.nn.gelu):
+    """Single-device oracle: same routing/capacity math, no dispatch
+    collectives.  x: (N, d) -> (out (N, d), aux_loss)."""
+    num_experts = params["router"].shape[1]
+    n = x.shape[0]
+    capacity = int(np.ceil(n * capacity_factor / num_experts))
+    dispatch, combine, aux = _route(params, x, num_experts, capacity)
+    xs = jnp.einsum("nec,nd->ecd", dispatch, x)         # (E, C, d)
+    ys = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                     params["b2"], xs, activation)
+    out = jnp.einsum("nec,ecd->nd", combine, ys)
+    return out.astype(x.dtype), aux
+
+
+def switch_moe_ep(params, x, axis=EXPERT_AXIS, capacity_factor=1.25,
+                  activation=jax.nn.gelu):
+    """Expert-parallel Switch FFN — call INSIDE shard_map with ``axis``
+    bound; x: local tokens (N_local, d); params' expert dims hold only
+    the local experts (E_local = E / ep).
+
+    -> (out (N_local, d), aux_loss local mean-contribution).
+    """
+    ep = lax.axis_size(axis)
+    e_local = params["w1"].shape[0]
+    num_experts = ep * e_local
+    n = x.shape[0]
+    capacity = int(np.ceil(n * capacity_factor / num_experts))
+    dispatch, combine, aux = _route(params, x, num_experts, capacity)
+
+    xs = jnp.einsum("nec,nd->ecd", dispatch, x)         # (E, C, d)
+    d = x.shape[-1]
+    # (E, C, d) -> (ep, E_local, C, d): dim0 = destination device
+    xs = xs.reshape(ep, e_local, capacity, d)
+    # EP collective #1: tokens travel to their expert's device; dim0
+    # becomes the SOURCE device after the exchange
+    xs = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0,
+                        tiled=False)
+    # each local expert processes its global queue in one batched matmul
+    xs = jnp.moveaxis(xs, 0, 1).reshape(e_local, ep * capacity, d)
+    ys = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                     params["b2"], xs, activation)
+    # EP collective #2: results travel home
+    ys = jnp.moveaxis(
+        ys.reshape(e_local, ep, capacity, d), 1, 0)     # (ep, E_l, C, d)
+    ys = lax.all_to_all(ys, axis, split_axis=0, concat_axis=0,
+                        tiled=False)
+    ys = ys.reshape(num_experts, capacity, d)
+    out = jnp.einsum("nec,ecd->nd", combine, ys)
+    return out.astype(x.dtype), aux

@@ -1,0 +1,136 @@
+"""Pipeline parallelism (PP) — GPipe schedule over a ``stages`` mesh axis.
+
+New capability surface: the reference has no model partitioning of any
+kind (SURVEY.md §2.3).  This implements the TPU-idiomatic version: layers
+are partitioned into P contiguous stages, one per device along the
+``stages`` axis; a batch is split into M microbatches that flow through
+the pipeline with ONE ``ppermute`` per tick (activations hop to the next
+stage over ICI), all inside a single jitted ``shard_map`` + ``lax.scan``
+— the schedule is compiled, not orchestrated from the host.
+
+Schedule: GPipe fill-drain.  T = M + P - 1 ticks; stage s processes
+microbatch m at tick t = m + s.  Bubble fraction = (P-1)/(M+P-1), so use
+M >> P.  Stages must be shape-preserving (x -> x of the same shape),
+which transformer blocks are; embedding/head stay outside the pipelined
+region (replicated compute).
+
+``gpipe_apply`` is the generic engine; ``pp_transformer_apply`` runs the
+standard ``models/transformer.py`` parameter pytree with its blocks
+sharded over stages — the single-device ``transformer_apply`` is the
+parity oracle (tests).  Backward is plain autodiff: the scan/ppermute
+transpose to the reverse schedule automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIPE_AXIS = "stages"
+
+
+def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS):
+    """Run a P-stage pipeline — call INSIDE shard_map with ``axis`` bound.
+
+    stage_fn(stage_params, x_mb) -> y_mb, shape-preserving.
+    stage_params: this device's stage parameters.
+    x: the FULL local batch (B, ...); split into ``num_microbatches``
+    along dim 0 (B % num_microbatches == 0).  Only stage 0 consumes it;
+    other devices receive activations over ICI.  Returns the full batch
+    output (valid on every device via a final psum).
+    """
+    p = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    mb = b // m
+    xs = x.reshape(m, mb, *x.shape[1:])
+
+    perm_fwd = [(i, i + 1) for i in range(p - 1)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 feeds microbatch t while t < m (clip keeps indexing
+        # static-shaped; the garbage tail microbatches never reach outs)
+        feed = xs[jnp.clip(t, 0, m - 1)]
+        inp = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(stage_params, inp)
+        # activations hop to the next stage; the last stage's output
+        # leaves the pipe here instead
+        buf_next = lax.ppermute(y, axis, perm_fwd)
+        mi = t - (p - 1)  # microbatch finishing at the last stage
+        take = jnp.logical_and(idx == p - 1, mi >= 0)
+        slot = jnp.clip(mi, 0, m - 1)
+        cur = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        upd = jnp.where(take, y, cur)
+        outs = lax.dynamic_update_index_in_dim(outs, upd, slot, 0)
+        return (buf_next, outs), None
+
+    from dist_keras_tpu.parallel.collectives import tree_pvary
+
+    buf0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+    outs0 = jnp.zeros((m, mb, *x.shape[1:]), x.dtype)
+    # the carry varies over the pipe axis (buf via ppermute, outs via the
+    # idx mask) — cast the zero init to varying so the scan carry type is
+    # stable under check_vma
+    buf0 = tree_pvary(buf0, axis)
+    outs0 = tree_pvary(outs0, axis)
+    (buf, outs), _ = lax.scan(tick, (buf0, outs0),
+                              jnp.arange(m + p - 1))
+    # only the last stage holds real outputs; broadcast to all stages so
+    # the head/loss can run replicated
+    outs = jnp.where(idx == p - 1, outs, 0.0)
+    outs = lax.psum(outs, axis)
+    return outs.reshape(b, *x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# transformer integration
+# ---------------------------------------------------------------------------
+def stack_blocks(blocks):
+    """list of per-block param dicts -> one pytree with leading L dim
+    (shard it over ``stages``: L/P blocks per device)."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+
+
+def pp_block_specs():
+    from jax.sharding import PartitionSpec as P
+
+    return P(PIPE_AXIS)
+
+
+def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
+                         causal=False, axis=PIPE_AXIS, attn_fn=None):
+    """Pipelined forward of ``models/transformer.py`` — call inside
+    shard_map.  ``params``: the non-block parameters (proj/pos/ln_f/head),
+    replicated; ``stacked_blocks``: this stage's (L_local, ...) block
+    stack.  x: (B, T, input_dim) local batch.  Embedding and head run
+    replicated on every stage (tiny); the L transformer blocks are the
+    pipelined region."""
+    from dist_keras_tpu.models.transformer import (
+        apply_block,
+        layer_norm as _ln,
+    )
+
+    if attn_fn is None:
+        # same dispatch as the single-device forward: Pallas flash kernel
+        # on TPU backends, jnp reference elsewhere
+        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
+
+        attn_fn = attention_auto
+
+    h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
+
+    def stage_fn(stage_blocks, h_mb):
+        def body(h, blk):
+            return apply_block(blk, h, attn_fn, causal), None
+
+        h_mb, _ = lax.scan(body, h_mb, stage_blocks)
+        return h_mb
+
+    h = gpipe_apply(stage_fn, stacked_blocks, h, num_microbatches, axis)
+    pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
+    return pooled @ params["head"]["kernel"] + params["head"]["bias"]

@@ -138,30 +138,13 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         opt_state = tx.init(params)
         return params, opt_state
 
-    def _opt_specs(params, pspecs, opt_state):
-        """Optimizer-state specs: each state leaf inherits the spec of the
-        param with the same global shape (adam's mu/nu mirror the param
-        tree leaf-for-leaf); scalar counters replicate.  Shape collisions
-        across *different* specs would be ambiguous -> hard error."""
-        shape_to_spec = {}
-        for arr, sp in zip(
-                jax.tree.leaves(params),
-                jax.tree.leaves(pspecs,
-                                is_leaf=lambda s: isinstance(s, P))):
-            shape = tuple(np.shape(arr))
-            if shape in shape_to_spec and shape_to_spec[shape] != sp:
-                raise ValueError(
-                    f"ambiguous sharding for shape {shape}: "
-                    f"{shape_to_spec[shape]} vs {sp}; choose distinct "
-                    "d_model/d_ff/seq_len sizes")
-            shape_to_spec[shape] = sp
-        return jax.tree.map(
-            lambda leaf: shape_to_spec.get(tuple(np.shape(leaf)), P()),
-            opt_state)
-
     def step_fn_factory(params, opt_state):
+        from dist_keras_tpu.parallel.fsdp import match_specs_by_shape
+
         pspecs = param_specs(params)
-        ospecs = _opt_specs(params, pspecs, opt_state)
+        # optimizer leaves inherit the same-shape param's spec (adam's
+        # mu/nu mirror the tree); ambiguous shapes hard-error
+        ospecs = match_specs_by_shape(params, pspecs, opt_state)
         data_x = P(WORKER_AXIS, SEQ_AXIS, None)
         data_y = P(WORKER_AXIS)
         return jax.jit(shard_map(
