@@ -231,7 +231,8 @@ def bench_transformer_tp(peak):
     cfg = transformer_config(input_dim=32, seq_len=seq, d_model=256,
                              n_heads=8, n_layers=4, n_classes=2)
     mesh = make_tp_mesh(dp=dp, tp=tp, sp=sp)
-    step_factory, init_fn = make_tp_train_step(mesh, cfg, causal=True)
+    step_factory, init_fn = make_tp_train_step(
+        mesh, cfg, causal=True, compute_dtype=jnp.bfloat16)
     params, opt_state = init_fn(0)
 
     rng = np.random.default_rng(0)
@@ -249,16 +250,23 @@ def bench_transformer_tp(peak):
     except Exception:
         pass
 
-    # warm-up + timed: params feed forward so steps chain (no caching)
-    params, opt_state, _ = fn(params, opt_state, x, y)
-    jax.block_until_ready(params)
+    # warm-up + timed: params feed forward so steps chain (no caching).
+    # Sync = a scalar readback that depends on the last step's UPDATED
+    # params (not just its loss, which is computed before the optimizer
+    # update) — block_until_ready does not reliably drain the axon
+    # tunnel.
+    def _sync(p):
+        return float(jnp.sum(p["head"]["bias"].astype(jnp.float32)))
+
+    params, opt_state, loss = fn(params, opt_state, x, y)
+    _sync(params)
     n_steps = 20
     best = None
     for _ in range(2):
         t0 = time.time()
         for _ in range(n_steps):
             params, opt_state, loss = fn(params, opt_state, x, y)
-        jax.block_until_ready(params)
+        _sync(params)
         sps = n_steps * batch / (time.time() - t0) / (dp * tp * sp)
         best = sps if best is None else max(best, sps)
     mfu = best * flops / peak if (peak and flops) else None

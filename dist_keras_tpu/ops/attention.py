@@ -127,8 +127,11 @@ def ring_attention(q, k, v, axis=SEQ_AXIS, causal=False, scale=None,
     q_start = idx * t_local
 
     # step 0: the diagonal block — local causal mask (global offsets
-    # cancel on the diagonal, so none are needed)
+    # cancel on the diagonal, so none are needed).  The merge accumulator
+    # runs in f32 regardless of input dtype (logspace weights are f32 and
+    # the fori_loop carry must be type-stable); cast back at the end.
     o, lse = attn_fn(q, k, v, causal=causal, scale=scale)
+    o = o.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def ring_step(r, carry):

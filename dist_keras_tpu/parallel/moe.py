@@ -63,7 +63,6 @@ def _route(params, x, num_experts, capacity):
     The aux load-balancing loss is the Switch mean(frac_tokens *
     frac_probs) * E.
     """
-    n = x.shape[0]
     logits = x @ params["router"]                      # (N, E)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate = jnp.max(probs, axis=-1)                     # (N,)

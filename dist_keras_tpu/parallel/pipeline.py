@@ -96,12 +96,6 @@ def stack_blocks(blocks):
     return jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
 
 
-def pp_block_specs():
-    from jax.sharding import PartitionSpec as P
-
-    return P(PIPE_AXIS)
-
-
 def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
                          causal=False, axis=PIPE_AXIS, attn_fn=None):
     """Pipelined forward of ``models/transformer.py`` — call inside

@@ -108,12 +108,15 @@ def tp_transformer_forward(params, x, cfg, causal=False):
 
 
 def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
-                       causal=False):
+                       causal=False, compute_dtype=None):
     """-> (step_fn, init_fn).
 
     init_fn(seed) -> (params, opt_state) on host.
     step_fn(params, opt_state, x, y) -> (params, opt_state, loss).
       x: (batch, seq_len, input_dim) global; y: (batch,) int labels.
+    ``compute_dtype=jnp.bfloat16`` casts params+activations for the
+    forward/backward (MXU fast path) while master params, gradients as
+    applied, and the loss stay f32 — same policy as trainers/step.py.
     """
     tx = optimizer or optax.adam(1e-3)
 
@@ -121,8 +124,15 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         # x local block: (B/workers, T/seq, input_dim); y: (B/workers,)
 
         def loss_fn(p):
-            logits = tp_transformer_forward(p, x, cfg, causal=causal)
-            logp = jax.nn.log_softmax(logits)
+            if compute_dtype is not None:
+                from dist_keras_tpu.utils.pytree import tree_cast
+
+                p = tree_cast(p, compute_dtype)
+                xc = x.astype(compute_dtype)
+            else:
+                xc = x
+            logits = tp_transformer_forward(p, xc, cfg, causal=causal)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
             nll = -jnp.take_along_axis(
                 logp, y[:, None].astype(jnp.int32), axis=-1).mean()
             # mean over the data-parallel axis -> AD emits the grad psums

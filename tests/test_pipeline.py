@@ -106,13 +106,9 @@ def test_pp_transformer_matches_oracle():
 
     # gradients: pipelined loss grad == oracle grad (blocks + embeddings)
     def loss_pp(rest_p, blocks_p):
-        logits = fn2(rest_p, blocks_p, x)
+        logits = fn(rest_p, blocks_p, x)
         logp = jax.nn.log_softmax(logits)
         return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
-
-    fn2 = jax.jit(shard_map(
-        fwd, mesh=mesh,
-        in_specs=(P(), P(PIPE_AXIS), P()), out_specs=P()))
 
     def loss_ref(rest_p, blocks_list):
         full = dict(rest_p, blocks=blocks_list)
