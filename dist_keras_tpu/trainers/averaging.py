@@ -110,12 +110,32 @@ class AveragingTrainer(DistributedTrainer):
 
 class EnsembleTrainer(DistributedTrainer):
     """Trains ``num_models`` independent replicas; returns a list of models
-    (majority voting at predict time is up to the user, as upstream)."""
+    (majority voting at predict time is up to the user, as upstream).
+
+    ``num_models`` may exceed the device count (the reference trains any
+    N over however many executors Spark has): models are laid out
+    ``(mesh slots, models_per_slot)`` and each slot ``vmap``s its
+    replicas — one compiled program regardless of the ratio."""
 
     def __init__(self, keras_model, num_models=2, **kw):
-        kw.setdefault("num_workers", num_models)
-        super().__init__(keras_model, **kw)
+        from dist_keras_tpu.parallel.mesh import num_available_devices
+
         self.num_models = int(num_models)
+        slots = kw.pop("num_workers", None)
+        if slots is None:
+            slots = min(self.num_models, num_available_devices())
+        if self.num_models % slots:
+            raise ValueError(
+                f"num_models={num_models} must divide evenly over "
+                f"{slots} mesh slots (pad num_models or pass "
+                "num_workers=<divisor>)")
+        super().__init__(keras_model, num_workers=slots, **kw)
+        self.models_per_slot = self.num_models // slots
+
+    def _cache_extras(self):
+        # slots alone no longer distinguishes configs: equal slot counts
+        # with different num_models bake different mps into the body
+        return super()._cache_extras() + (self.num_models,)
 
     def train(self, dataset, shuffle=False):
         import time as _time
@@ -123,29 +143,50 @@ class EnsembleTrainer(DistributedTrainer):
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
-        xs, ys = self._shards(dataset)
-        mesh = self.mesh
+        # one data shard per MODEL (reference: one partition per model);
+        # leading axis regrouped (slots, models_per_slot, steps, ...)
+        mps = self.models_per_slot
+        mesh = self.mesh  # prime the slot mesh BEFORE the worker swap
+        if mps > 1 and comm.is_multi_host():
+            raise NotImplementedError(
+                "models_per_slot > 1 with multi-host feeding is not "
+                "supported yet; pass num_workers=num_models")
+        saved_workers = self.num_workers
+        self.num_workers = self.num_models
+        try:
+            xs, ys = self._shards(dataset)
+        finally:
+            self.num_workers = saved_workers
+        xs = xs.reshape(self.num_workers, mps, *xs.shape[1:])
+        ys = ys.reshape(self.num_workers, mps, *ys.shape[1:])
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
 
         def build_chunk(E):
             def body(params, opt_state, xs, ys, key, epoch0):
+                # carry arrives stacked (1, mps, ...) per slot
                 xs, ys = xs[0], ys[0]
-                rng = jax.random.fold_in(
-                    key, jax.lax.axis_index(WORKER_AXIS))
-                # carry arrives stacked (1, ...) per model replica
                 params = jax.tree.map(lambda t: t[0], params)
                 opt_state = jax.tree.map(lambda t: t[0], opt_state)
+                slot = jax.lax.axis_index(WORKER_AXIS)
+                midx = slot * mps + jnp.arange(mps)  # global model ids
 
-                def epoch(carry, e):
-                    params, opt_state = carry
-                    erng = tree_pvary(jax.random.fold_in(rng, e))
-                    (params, opt_state, _), losses = jax.lax.scan(
-                        step, (params, opt_state, erng), (xs, ys))
-                    return (params, opt_state), losses
+                def per_model(p, o, x, y, mi):
+                    rng = jax.random.fold_in(key, mi)
 
-                (params, opt_state), losses = jax.lax.scan(
-                    epoch, (params, opt_state), jnp.arange(E) + epoch0)
+                    def epoch(carry, e):
+                        p, o = carry
+                        erng = tree_pvary(jax.random.fold_in(rng, e))
+                        (p, o, _), losses = jax.lax.scan(
+                            step, (p, o, erng), (x, y))
+                        return (p, o), losses
+
+                    (p, o), losses = jax.lax.scan(
+                        epoch, (p, o), jnp.arange(E) + epoch0)
+                    return p, o, losses
+
+                params, opt_state, losses = jax.vmap(per_model)(
+                    params, opt_state, xs, ys, midx)
                 stack = lambda t: t[None]  # noqa: E731
                 return (jax.tree.map(stack, params),
                         jax.tree.map(stack, opt_state), losses[None])
@@ -157,8 +198,9 @@ class EnsembleTrainer(DistributedTrainer):
                 out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
-        stacked = self._stack_workers(model.params)
-        opt_state = self._stack_workers(opt_init(model.params))
+        stacked = self._stack_workers(model.params, inner=(mps,))
+        opt_state = self._stack_workers(opt_init(model.params),
+                                        inner=(mps,))
         start_epoch, restored = self._maybe_resume(
             {"params": stacked, "opt_state": opt_state})
         if restored is not None:
@@ -168,7 +210,9 @@ class EnsembleTrainer(DistributedTrainer):
         xs = self._to_device(xs)
         ys = self._to_device(ys)
         key = jax.random.PRNGKey(self.seed)
-        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
+        # xs: (slots, mps, steps, batch, ...)
+        samples_per_epoch = (xs.shape[0] * xs.shape[1] * xs.shape[2]
+                             * self.batch_size)
 
         self.record_training_start()
         all_losses = []
@@ -181,7 +225,9 @@ class EnsembleTrainer(DistributedTrainer):
             jax.block_until_ready(stacked)
             dt = _time.time() - t0
             epochs_done += E
+            # (slots, mps, E, steps) -> (num_models, E, steps)
             losses = np.asarray(comm.fetch_global(losses))
+            losses = losses.reshape(self.num_models, *losses.shape[2:])
             all_losses.append(losses)
             self._emit_epoch_end(epochs_done, losses, dt,
                                  samples_per_epoch * E)
@@ -193,9 +239,13 @@ class EnsembleTrainer(DistributedTrainer):
         self.history = (np.concatenate(all_losses, axis=1).tolist()
                         if all_losses else [])
 
+        # one device->host transfer for the whole ensemble, then slice
+        host = jax.tree.map(
+            lambda x: np.asarray(x).reshape(
+                self.num_models, *x.shape[2:]), stacked)
         models = []
         for i in range(self.num_models):
             m = self._fresh_model()
-            m.set_params(jax.tree.map(lambda x: np.asarray(x[i]), stacked))
+            m.set_params(jax.tree.map(lambda x: x[i], host))
             models.append(m)
         return models

@@ -78,10 +78,11 @@ class Trainer:
     def get_history(self):
         """Per-step training losses.
 
-        Shapes by trainer: SingleTrainer -> (steps,); AveragingTrainer /
-        EnsembleTrainer -> (workers, epochs, steps); windowed family
-        (DOWNPOUR/ADAG/AEASGD/EAMSGD) -> (workers, epochs, windows, W);
-        DynSGD -> (workers, epochs, steps).
+        Shapes by trainer: SingleTrainer -> (steps,); AveragingTrainer ->
+        (workers, epochs, steps); EnsembleTrainer -> (num_models, epochs,
+        steps); windowed family (DOWNPOUR/ADAG/AEASGD/EAMSGD) ->
+        (workers, epochs, windows, W); DynSGD -> (workers, epochs,
+        steps).
         """
         return self.history
 
@@ -313,11 +314,12 @@ class DistributedTrainer(Trainer):
             NamedSharding(self.mesh, P(WORKER_AXIS)), x,
             (self.num_workers,) + x.shape[1:])
 
-    def _stack_workers(self, tree):
-        """Replicate a pytree with a leading (num_workers,) axis — the
-        host-side layout of per-worker carry state (local replicas,
+    def _stack_workers(self, tree, inner=()):
+        """Replicate a pytree with a leading (num_workers, *inner) axis —
+        the host-side layout of per-worker carry state (local replicas,
         optimizer state) that crosses chunked-dispatch boundaries sharded
-        over the worker mesh axis.
+        over the worker mesh axis.  ``inner`` adds unsharded replica dims
+        inside each slot (EnsembleTrainer's models-per-slot).
 
         The broadcast stays a zero-copy numpy view on the host and each
         leaf is ``device_put`` (or process-local assembly on multi-host)
@@ -333,6 +335,7 @@ class DistributedTrainer(Trainer):
         n = self.num_workers
         sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
 
+        lead = (1,) * (1 + len(inner))
         if comm.is_multi_host():
             lo, hi = self._local_worker_range()
 
@@ -340,12 +343,14 @@ class DistributedTrainer(Trainer):
                 x = np.asarray(x)
                 return jax.make_array_from_process_local_data(
                     sharding,
-                    np.broadcast_to(x[None], (hi - lo,) + x.shape),
-                    (n,) + x.shape)
+                    np.broadcast_to(x.reshape(lead + x.shape),
+                                    (hi - lo,) + inner + x.shape),
+                    (n,) + inner + x.shape)
         else:
             def _stack(x):
                 x = np.asarray(x)
                 return jax.device_put(
-                    np.broadcast_to(x[None], (n,) + x.shape), sharding)
+                    np.broadcast_to(x.reshape(lead + x.shape),
+                                    (n,) + inner + x.shape), sharding)
 
         return jax.tree.map(_stack, tree)
