@@ -25,7 +25,12 @@ from dist_keras_tpu.ops.attention import attention  # noqa: F401 (oracle)
 
 
 def transformer_config(input_dim, seq_len, d_model=64, n_heads=4,
-                       n_layers=2, d_ff=None, n_classes=2):
+                       n_layers=2, d_ff=None, n_classes=2,
+                       moe_experts=0, moe_capacity_factor=1.25):
+    """``moe_experts > 0`` replaces every block's dense FFN with a
+    Switch-MoE FFN of that many experts (parallel/moe.py) — use
+    ``transformer_apply_with_aux`` / ``make_moe_train_step`` so the
+    router's load-balancing aux loss reaches the objective."""
     return {
         "input_dim": int(input_dim),
         "seq_len": int(seq_len),
@@ -34,6 +39,8 @@ def transformer_config(input_dim, seq_len, d_model=64, n_heads=4,
         "n_layers": int(n_layers),
         "d_ff": int(d_ff if d_ff is not None else 4 * d_model),
         "n_classes": int(n_classes),
+        "moe_experts": int(moe_experts),
+        "moe_capacity_factor": float(moe_capacity_factor),
     }
 
 
@@ -56,19 +63,28 @@ def init_transformer_params(key, cfg):
         "head": {"kernel": dense((d, cfg["n_classes"])),
                  "bias": jnp.zeros((cfg["n_classes"],))},
     }
+    moe = cfg.get("moe_experts", 0)
     for _ in range(cfg["n_layers"]):
-        params["blocks"].append({
+        blk = {
             "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
             "wq": dense((d, h, dh)),
             "wk": dense((d, h, dh)),
             "wv": dense((d, h, dh)),
             "wo": dense((h, dh, d)),
             "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
-            "w1": dense((d, ff)),
-            "b1": jnp.zeros((ff,)),
-            "w2": dense((ff, d)),
-            "b2": jnp.zeros((d,)),
-        })
+        }
+        if moe:
+            from dist_keras_tpu.parallel.moe import init_moe_params
+
+            blk["moe"] = init_moe_params(next(keys), d, ff, moe)
+        else:
+            blk.update({
+                "w1": dense((d, ff)),
+                "b1": jnp.zeros((ff,)),
+                "w2": dense((ff, d)),
+                "b2": jnp.zeros((d,)),
+            })
+        params["blocks"].append(blk)
     return params
 
 
@@ -83,10 +99,13 @@ def layer_norm(p, x, eps=1e-5):
 _ln = layer_norm
 
 
-def apply_block(blk, h, attn_fn, causal):
-    """One pre-LN attention+FFN residual block — the single definition
-    shared by the oracle forward, the TP step, and the pipelined forward
-    (parallel/pipeline.py), so their math can never silently diverge."""
+def apply_block_aux(blk, h, attn_fn, causal, capacity_factor=1.25):
+    """One pre-LN attention+FFN residual block -> (h, aux).
+
+    The single definition shared by the oracle forward, the TP step and
+    the pipelined forward, so their math can never silently diverge.
+    Dense blocks return aux = 0.0; MoE blocks (``"moe"`` in blk) return
+    the Switch router's load-balancing loss."""
     y = _ln(blk["ln1"], h)
     q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
     k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
@@ -94,8 +113,42 @@ def apply_block(blk, h, attn_fn, causal):
     a = attn_fn(q, k, v, causal=causal)
     h = h + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
     y = _ln(blk["ln2"], h)
+    if "moe" in blk:
+        from dist_keras_tpu.parallel.moe import switch_moe_dense
+
+        b, t, d = y.shape
+        u, aux = switch_moe_dense(blk["moe"], y.reshape(b * t, d),
+                                  capacity_factor=capacity_factor)
+        return h + u.reshape(b, t, d), aux
     u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
-    return h + u @ blk["w2"] + blk["b2"]
+    return h + u @ blk["w2"] + blk["b2"], jnp.float32(0.0)
+
+
+def apply_block(blk, h, attn_fn, causal):
+    """Dense-FFN block (aux discarded — MoE blocks must go through
+    ``apply_block_aux`` so the router loss reaches the objective)."""
+    h, _ = apply_block_aux(blk, h, attn_fn, causal)
+    return h
+
+
+def transformer_apply_with_aux(params, x, cfg, *, causal=False,
+                               attn_fn=None):
+    """Forward returning (logits, total_aux_loss) — required for MoE
+    configs; identical to ``transformer_apply`` for dense ones."""
+    if attn_fn is None:
+        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
+
+        attn_fn = attention_auto
+    cf = cfg.get("moe_capacity_factor", 1.25)
+    h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
+    aux = jnp.float32(0.0)
+    for blk in params["blocks"]:
+        h, a = apply_block_aux(blk, h, attn_fn, causal,
+                               capacity_factor=cf)
+        aux = aux + a
+    pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
+    logits = pooled @ params["head"]["kernel"] + params["head"]["bias"]
+    return logits, aux
 
 
 def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
@@ -107,20 +160,25 @@ def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
     the jnp reference elsewhere (``attention_auto``).  Pass
     ``attn_fn=attention`` to force the jnp oracle.
     """
-    if attn_fn is None:
-        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
-
-        attn_fn = attention_auto
-    h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
-    for blk in params["blocks"]:
-        h = apply_block(blk, h, attn_fn, causal)
-    pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
-    return pooled @ params["head"]["kernel"] + params["head"]["bias"]
+    if cfg.get("moe_experts", 0):
+        raise ValueError(
+            "MoE transformer configs must use transformer_apply_with_aux "
+            "(or make_moe_train_step) so the router's load-balancing "
+            "loss reaches the objective; for pure inference the "
+            "Transformer wrapper's apply() discards aux for you")
+    logits, _ = transformer_apply_with_aux(
+        params, x, cfg, causal=causal, attn_fn=attn_fn)
+    return logits
 
 
 class Transformer:
     """Model-contract wrapper (params + apply + weights round-trip) so the
-    standard trainers accept a Transformer like any other model."""
+    standard trainers accept a Transformer like any other model.
+
+    MoE configs: ``apply`` DISCARDS the router aux loss — fine for
+    inference/prediction; for training prefer ``make_moe_train_step``
+    (the Switch objective), since standard trainers going through
+    ``apply`` would optimize nll without the load-balancing term."""
 
     def __init__(self, cfg=None, seed=0, **cfg_kw):
         self.cfg = cfg or transformer_config(**cfg_kw)
@@ -129,6 +187,9 @@ class Transformer:
         self.name = "transformer"
 
     def apply(self, params, x, *, training=False, rng=None):
+        if self.cfg.get("moe_experts", 0):
+            logits, _ = transformer_apply_with_aux(params, x, self.cfg)
+            return logits
         return transformer_apply(params, x, self.cfg)
 
     def __call__(self, x, *, training=False, rng=None):

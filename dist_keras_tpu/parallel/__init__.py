@@ -19,6 +19,7 @@ from dist_keras_tpu.parallel.mesh import (
 from dist_keras_tpu.parallel.moe import (
     EXPERT_AXIS,
     init_moe_params,
+    make_moe_train_step,
     moe_param_specs,
     switch_moe_dense,
     switch_moe_ep,
@@ -35,6 +36,6 @@ __all__ = [
     "tree_psum", "tree_pmean", "tree_all_gather", "tree_ppermute",
     "fsdp_specs", "make_fsdp_train_step", "train_fsdp",
     "EXPERT_AXIS", "init_moe_params", "moe_param_specs",
-    "switch_moe_dense", "switch_moe_ep",
+    "switch_moe_dense", "switch_moe_ep", "make_moe_train_step",
     "PIPE_AXIS", "gpipe_apply", "pp_transformer_apply", "stack_blocks",
 ]

@@ -27,6 +27,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 
 from dist_keras_tpu.models.layers import glorot_uniform
@@ -138,3 +139,49 @@ def switch_moe_ep(params, x, axis=EXPERT_AXIS, capacity_factor=1.25,
     ys = ys.reshape(num_experts, capacity, d)
     out = jnp.einsum("nec,ecd->nd", combine, ys)
     return out.astype(x.dtype), aux
+
+
+# ---------------------------------------------------------------------------
+# MoE transformer training step
+# ---------------------------------------------------------------------------
+def make_moe_train_step(cfg, optimizer=None, aux_weight=1e-2, causal=False,
+                        attn_fn=None):
+    """-> (init_fn, step) for a MoE transformer
+    (``transformer_config(moe_experts=E)``).
+
+    The objective is ``nll + aux_weight * router_load_balance`` (the
+    Switch recipe) — the reason MoE configs can't train through the
+    plain ``transformer_apply`` path.  step(params, opt_state, x, y) ->
+    (params, opt_state, {"loss", "nll", "aux"}).
+    """
+    tx = optimizer or optax.adam(1e-3)
+
+    def init_fn(seed=0):
+        from dist_keras_tpu.models.transformer import (
+            init_transformer_params,
+        )
+
+        params = init_transformer_params(jax.random.PRNGKey(seed), cfg)
+        return params, tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        from dist_keras_tpu.models.transformer import (
+            transformer_apply_with_aux,
+        )
+
+        def loss_fn(p):
+            logits, aux = transformer_apply_with_aux(
+                p, x, cfg, causal=causal, attn_fn=attn_fn)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+            return nll + aux_weight * aux, (nll, aux)
+
+        (loss, (nll, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "nll": nll, "aux": aux}
+
+    return init_fn, step

@@ -109,6 +109,12 @@ def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
         layer_norm as _ln,
     )
 
+    if cfg.get("moe_experts", 0):
+        raise ValueError(
+            "pipelined MoE blocks are not supported yet (the router aux "
+            "loss has no channel through the pipeline); use "
+            "make_moe_train_step")
+
     if attn_fn is None:
         # same dispatch as the single-device forward: Pallas flash kernel
         # on TPU backends, jnp reference elsewhere

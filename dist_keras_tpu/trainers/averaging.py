@@ -123,6 +123,9 @@ class EnsembleTrainer(DistributedTrainer):
         self.num_models = int(num_models)
         slots = kw.pop("num_workers", None)
         if slots is None:
+            # device count must come AFTER multi-host bring-up (querying
+            # devices initializes the backend; see base.mesh ordering)
+            comm.initialize()
             slots = min(self.num_models, num_available_devices())
         if self.num_models % slots:
             raise ValueError(

@@ -127,3 +127,39 @@ def test_aux_loss_prefers_balance():
                             .at[:, 0].set(10.0))
     _, aux_c = switch_moe_dense(params_collapsed, x_pos)
     assert float(aux_c) > 4.0
+
+
+def test_moe_transformer_trains():
+    """transformer_config(moe_experts=4): the full MoE transformer trains
+    end-to-end with the Switch objective; the plain apply path refuses
+    MoE configs (the aux loss would be silently dropped)."""
+    import numpy as np
+
+    from dist_keras_tpu.models.transformer import (
+        transformer_apply,
+        transformer_config,
+    )
+    from dist_keras_tpu.ops.attention import attention
+    from dist_keras_tpu.parallel.moe import make_moe_train_step
+
+    cfg = transformer_config(input_dim=8, seq_len=16, d_model=32,
+                             n_heads=2, n_layers=2, n_classes=2,
+                             moe_experts=4, moe_capacity_factor=2.0)
+    init_fn, step = make_moe_train_step(cfg, aux_weight=1e-2,
+                                        attn_fn=attention)
+    params, opt_state = init_fn(0)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 16, 8)), jnp.float32)
+    y = jnp.asarray((np.asarray(x)[:, :, 0].mean(1) > 0).astype(np.int32))
+
+    metrics0 = None
+    for _ in range(40):
+        params, opt_state, metrics = step(params, opt_state, x, y)
+        if metrics0 is None:
+            metrics0 = {k: float(v) for k, v in metrics.items()}
+    assert float(metrics["nll"]) < metrics0["nll"] * 0.5
+    assert np.isfinite(float(metrics["aux"]))
+
+    with pytest.raises(ValueError, match="aux"):
+        transformer_apply(params, x, cfg)
