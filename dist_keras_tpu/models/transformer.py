@@ -16,6 +16,8 @@ Layout notes (TPU-first):
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -132,33 +134,44 @@ def apply_block(blk, h, attn_fn, causal):
 
 
 def transformer_apply_with_aux(params, x, cfg, *, causal=False,
-                               attn_fn=None):
+                               attn_fn=None, remat=False):
     """Forward returning (logits, total_aux_loss) — required for MoE
-    configs; identical to ``transformer_apply`` for dense ones."""
+    configs; identical to ``transformer_apply`` for dense ones.
+
+    ``remat=True`` wraps each block in ``jax.checkpoint``: activations
+    inside a block are recomputed during the backward instead of stored,
+    trading ~1 extra forward of FLOPs for O(layers) less HBM — the
+    standard long-context/deep-model memory lever.
+    """
     if attn_fn is None:
         from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
 
         attn_fn = attention_auto
     cf = cfg.get("moe_capacity_factor", 1.25)
+    block = functools.partial(apply_block_aux, attn_fn=attn_fn,
+                              causal=causal, capacity_factor=cf)
+    if remat:
+        block = jax.checkpoint(block)
     h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
     aux = jnp.float32(0.0)
     for blk in params["blocks"]:
-        h, a = apply_block_aux(blk, h, attn_fn, causal,
-                               capacity_factor=cf)
+        h, a = block(blk, h)
         aux = aux + a
     pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
     logits = pooled @ params["head"]["kernel"] + params["head"]["bias"]
     return logits, aux
 
 
-def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
+def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None,
+                      remat=False):
     """Forward pass.  x: (B, T, input_dim) -> logits (B, n_classes).
 
     ``attn_fn`` is injectable so the sharded step can swap in
     ``ring_attention`` while reusing every other line of this function;
     the default dispatches to the Pallas flash kernel on TPU backends and
     the jnp reference elsewhere (``attention_auto``).  Pass
-    ``attn_fn=attention`` to force the jnp oracle.
+    ``attn_fn=attention`` to force the jnp oracle.  ``remat=True``
+    checkpoints each block (see ``transformer_apply_with_aux``).
     """
     if cfg.get("moe_experts", 0):
         raise ValueError(
@@ -167,7 +180,7 @@ def transformer_apply(params, x, cfg, *, causal=False, attn_fn=None):
             "loss reaches the objective; for pure inference the "
             "Transformer wrapper's apply() discards aux for you")
     logits, _ = transformer_apply_with_aux(
-        params, x, cfg, causal=causal, attn_fn=attn_fn)
+        params, x, cfg, causal=causal, attn_fn=attn_fn, remat=remat)
     return logits
 
 
@@ -188,6 +201,12 @@ class Transformer:
 
     def apply(self, params, x, *, training=False, rng=None):
         if self.cfg.get("moe_experts", 0):
+            if training:
+                raise ValueError(
+                    "training a MoE Transformer through the standard "
+                    "model contract would silently drop the router "
+                    "load-balancing loss; use "
+                    "parallel.make_moe_train_step instead")
             logits, _ = transformer_apply_with_aux(params, x, self.cfg)
             return logits
         return transformer_apply(params, x, self.cfg)

@@ -145,7 +145,7 @@ def switch_moe_ep(params, x, axis=EXPERT_AXIS, capacity_factor=1.25,
 # MoE transformer training step
 # ---------------------------------------------------------------------------
 def make_moe_train_step(cfg, optimizer=None, aux_weight=1e-2, causal=False,
-                        attn_fn=None):
+                        attn_fn=None, remat=False):
     """-> (init_fn, step) for a MoE transformer
     (``transformer_config(moe_experts=E)``).
 
@@ -172,7 +172,7 @@ def make_moe_train_step(cfg, optimizer=None, aux_weight=1e-2, causal=False,
 
         def loss_fn(p):
             logits, aux = transformer_apply_with_aux(
-                p, x, cfg, causal=causal, attn_fn=attn_fn)
+                p, x, cfg, causal=causal, attn_fn=attn_fn, remat=remat)
             logp = jax.nn.log_softmax(logits)
             nll = -jnp.take_along_axis(
                 logp, y[:, None].astype(jnp.int32), axis=-1).mean()

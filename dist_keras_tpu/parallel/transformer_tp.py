@@ -77,38 +77,51 @@ def param_specs(params):
     }
 
 
-def tp_transformer_forward(params, x, cfg, causal=False):
+def _tp_block(blk, h, causal):
+    """One Megatron-split block on local shards (heads/ff over ``model``,
+    tokens over ``seq`` via ring attention)."""
+    y = _ln(blk["ln1"], h)
+    # local heads only: wq/wk/wv are head-sharded over `model`
+    q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
+    k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
+    v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
+    a = ring_attention(q, k, v, axis=SEQ_AXIS, causal=causal)
+    # partial over local heads -> reduce over the model axis
+    o = jnp.einsum("bthk,hkd->btd", a, blk["wo"])
+    h = h + lax.psum(o, MODEL_AXIS)
+    y = _ln(blk["ln2"], h)
+    u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])  # column-parallel
+    z = u @ blk["w2"]                           # row-parallel
+    return h + lax.psum(z, MODEL_AXIS) + blk["b2"]
+
+
+def tp_transformer_forward(params, x, cfg, causal=False, remat=False):
     """Sharded forward: call inside shard_map over (workers, model, seq).
 
     x: local activation block (B_local, T_local, input_dim).
     Returns logits (B_local, n_classes), replicated over model+seq axes.
+    ``remat=True`` checkpoints each block — the long-context memory
+    lever: per-block activations (incl. ring attention state) are
+    recomputed in the backward instead of stored, at the cost of one
+    extra forward (including its collectives).
     """
     t_local = x.shape[1]
     seq_idx = lax.axis_index(SEQ_AXIS)
     pos = lax.dynamic_slice_in_dim(
         params["pos"], seq_idx * t_local, t_local, axis=0)
     h = x @ params["proj"] + pos[None]
+    block = jax.checkpoint(
+        lambda blk, h: _tp_block(blk, h, causal)) if remat else (
+        lambda blk, h: _tp_block(blk, h, causal))
     for blk in params["blocks"]:
-        y = _ln(blk["ln1"], h)
-        # local heads only: wq/wk/wv are head-sharded over `model`
-        q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
-        k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
-        v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
-        a = ring_attention(q, k, v, axis=SEQ_AXIS, causal=causal)
-        # partial over local heads -> reduce over the model axis
-        o = jnp.einsum("bthk,hkd->btd", a, blk["wo"])
-        h = h + lax.psum(o, MODEL_AXIS)
-        y = _ln(blk["ln2"], h)
-        u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])  # column-parallel
-        z = u @ blk["w2"]                           # row-parallel
-        h = h + lax.psum(z, MODEL_AXIS) + blk["b2"]
+        h = block(blk, h)
     pooled_local = jnp.sum(_ln(params["ln_f"], h), axis=1)
     pooled = lax.psum(pooled_local, SEQ_AXIS) / cfg["seq_len"]
     return pooled @ params["head"]["kernel"] + params["head"]["bias"]
 
 
 def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
-                       causal=False, compute_dtype=None):
+                       causal=False, compute_dtype=None, remat=False):
     """-> (step_fn, init_fn).
 
     init_fn(seed) -> (params, opt_state) on host.
@@ -118,6 +131,11 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
     forward/backward (MXU fast path) while master params, gradients as
     applied, and the loss stay f32 — same policy as trainers/step.py.
     """
+    if cfg.get("moe_experts", 0):
+        raise ValueError(
+            "the Megatron TP step supports dense FFN blocks only; for "
+            "MoE use make_moe_train_step (dense compute) or "
+            "switch_moe_ep (expert parallelism)")
     tx = optimizer or optax.adam(1e-3)
 
     def body(params, opt_state, x, y):
@@ -131,7 +149,8 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
                 xc = x.astype(compute_dtype)
             else:
                 xc = x
-            logits = tp_transformer_forward(p, xc, cfg, causal=causal)
+            logits = tp_transformer_forward(p, xc, cfg, causal=causal,
+                                            remat=remat)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32))
             nll = -jnp.take_along_axis(
                 logp, y[:, None].astype(jnp.int32), axis=-1).mean()

@@ -124,3 +124,35 @@ def test_sequential_split_join_state():
     rejoined = m.join_state(t, s)
     for a, b in zip(jax.tree.leaves(rejoined), jax.tree.leaves(m.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_transformer_remat_matches_plain():
+    """remat=True (jax.checkpoint per block) must be a pure memory/FLOP
+    trade: identical logits and gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from dist_keras_tpu.models.transformer import (
+        init_transformer_params,
+        transformer_apply,
+        transformer_config,
+    )
+    from dist_keras_tpu.ops.attention import attention
+
+    cfg = transformer_config(input_dim=6, seq_len=12, d_model=16,
+                             n_heads=2, n_layers=3, n_classes=2)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 12, 6)),
+                    jnp.float32)
+
+    def loss(p, remat):
+        out = transformer_apply(p, x, cfg, causal=True,
+                                attn_fn=attention, remat=remat)
+        return jnp.sum(out ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(p, False))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(p, True))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), g0, g1)

@@ -112,3 +112,31 @@ def test_tp_training_reduces_loss():
     _, losses = train_tp_transformer(mesh, CFG, x, y, steps=20,
                                      optimizer=optax.adam(3e-3))
     assert losses[-1] < losses[0]
+
+
+def test_tp_remat_matches_plain():
+    """remat=True in the sharded step: identical loss and updated params
+    (pure memory/FLOP trade, collectives included in the recompute)."""
+    import optax
+
+    cfg = transformer_config(input_dim=6, seq_len=8, d_model=16,
+                             n_heads=2, n_layers=2, n_classes=3)
+    mesh = make_tp_mesh(dp=2, tp=2, sp=2)
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.normal(size=(4, 8, 6)), np.float32)
+    y = rng.integers(0, 3, 4).astype(np.int32)
+
+    results = []
+    for remat in (False, True):
+        factory, init_fn = make_tp_train_step(
+            mesh, cfg, optimizer=optax.sgd(0.1), causal=True, remat=remat)
+        params, opt_state = init_fn(0)
+        fn = factory(params, opt_state)
+        p1, _, loss = fn(params, opt_state, jnp.asarray(x),
+                         jnp.asarray(y))
+        results.append((float(loss), p1))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        results[0][1], results[1][1])
