@@ -32,7 +32,7 @@ chip's bf16 peak.  Peak is looked up from device_kind
 
 Method per config: train on synthetic device-resident data with the REAL
 trainer (windowed commits, dropout active, f32 master weights); first
-.train() compiles (shared executable cache), then best-of-2 timed runs —
+.train() compiles (shared executable cache), then best-of-3 timed runs —
 the axon tunnel's H2D latency varies by seconds run to run.
 """
 
@@ -103,7 +103,7 @@ def _run_trainer_config(name, make_trainer, ds, batch, flops_per_sample,
 
     make_trainer().train(ds)  # compile warm-up (shared jit cache)
     best = None
-    for _ in range(2):
+    for _ in range(3):  # best-of-3: the tunnel's latency varies by seconds
         t = make_trainer()
         t.train(ds)
         dt = t.get_training_time()

@@ -24,6 +24,8 @@ all absent upstream).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -110,9 +112,9 @@ def tp_transformer_forward(params, x, cfg, causal=False, remat=False):
     pos = lax.dynamic_slice_in_dim(
         params["pos"], seq_idx * t_local, t_local, axis=0)
     h = x @ params["proj"] + pos[None]
-    block = jax.checkpoint(
-        lambda blk, h: _tp_block(blk, h, causal)) if remat else (
-        lambda blk, h: _tp_block(blk, h, causal))
+    block = functools.partial(_tp_block, causal=causal)
+    if remat:
+        block = jax.checkpoint(block)
     for blk in params["blocks"]:
         h = block(blk, h)
     pooled_local = jnp.sum(_ln(params["ln_f"], h), axis=1)
@@ -186,14 +188,16 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
 
 
 def train_tp_transformer(mesh, cfg, x, y, steps=10, optimizer=None,
-                         seed=0, causal=False):
+                         seed=0, causal=False, compute_dtype=None,
+                         remat=False):
     """Convenience host loop: compile once, run ``steps`` full-batch updates.
 
     x: (N, seq_len, input_dim); y: (N,) int labels.  N must divide by the
     mesh's ``workers`` size and seq_len by its ``seq`` size.
     """
     step_factory, init_fn = make_tp_train_step(
-        mesh, cfg, optimizer=optimizer, causal=causal)
+        mesh, cfg, optimizer=optimizer, causal=causal,
+        compute_dtype=compute_dtype, remat=remat)
     params, opt_state = init_fn(seed)
     fn = step_factory(params, opt_state)
     losses = []
