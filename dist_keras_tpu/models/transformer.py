@@ -101,13 +101,17 @@ def layer_norm(p, x, eps=1e-5):
 _ln = layer_norm
 
 
-def apply_block_aux(blk, h, attn_fn, causal, capacity_factor=1.25):
+def apply_block_aux(blk, h, attn_fn, causal, capacity_factor=1.25,
+                    moe_fn=None):
     """One pre-LN attention+FFN residual block -> (h, aux).
 
-    The single definition shared by the oracle forward, the TP step and
-    the pipelined forward, so their math can never silently diverge.
-    Dense blocks return aux = 0.0; MoE blocks (``"moe"`` in blk) return
-    the Switch router's load-balancing loss."""
+    The single definition shared by the oracle forward, the TP step, the
+    pipelined forward AND the expert-parallel step, so their math can
+    never silently diverge.  Dense blocks return aux = 0.0; MoE blocks
+    (``"moe"`` in blk) return the Switch router's load-balancing loss.
+    ``moe_fn(moe_params, tokens_2d) -> (out_2d, aux)`` is injectable —
+    the EP step swaps in ``switch_moe_ep``; default is the dense
+    single-device mixture."""
     y = _ln(blk["ln1"], h)
     q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
     k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
@@ -116,11 +120,13 @@ def apply_block_aux(blk, h, attn_fn, causal, capacity_factor=1.25):
     h = h + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
     y = _ln(blk["ln2"], h)
     if "moe" in blk:
-        from dist_keras_tpu.parallel.moe import switch_moe_dense
+        if moe_fn is None:
+            from dist_keras_tpu.parallel.moe import switch_moe_dense
 
+            moe_fn = functools.partial(switch_moe_dense,
+                                       capacity_factor=capacity_factor)
         b, t, d = y.shape
-        u, aux = switch_moe_dense(blk["moe"], y.reshape(b * t, d),
-                                  capacity_factor=capacity_factor)
+        u, aux = moe_fn(blk["moe"], y.reshape(b * t, d))
         return h + u.reshape(b, t, d), aux
     u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
     return h + u @ blk["w2"] + blk["b2"], jnp.float32(0.0)

@@ -19,8 +19,10 @@ from dist_keras_tpu.parallel.mesh import (
 from dist_keras_tpu.parallel.moe import (
     EXPERT_AXIS,
     init_moe_params,
+    make_moe_ep_train_step,
     make_moe_train_step,
     moe_param_specs,
+    moe_transformer_param_specs,
     switch_moe_dense,
     switch_moe_ep,
 )
@@ -37,5 +39,6 @@ __all__ = [
     "fsdp_specs", "make_fsdp_train_step", "train_fsdp",
     "EXPERT_AXIS", "init_moe_params", "moe_param_specs",
     "switch_moe_dense", "switch_moe_ep", "make_moe_train_step",
+    "make_moe_ep_train_step", "moe_transformer_param_specs",
     "PIPE_AXIS", "gpipe_apply", "pp_transformer_apply", "stack_blocks",
 ]

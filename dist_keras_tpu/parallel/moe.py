@@ -185,3 +185,115 @@ def make_moe_train_step(cfg, optimizer=None, aux_weight=1e-2, causal=False,
         return params, opt_state, {"loss": loss, "nll": nll, "aux": aux}
 
     return init_fn, step
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel MoE transformer training step
+# ---------------------------------------------------------------------------
+def moe_transformer_param_specs(params, axis=EXPERT_AXIS):
+    """PartitionSpec pytree for an MoE transformer: expert stacks sharded
+    over ``axis``, everything else (attention, LN, router, embeddings)
+    replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def leaf_spec(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if "moe" in keys and keys[-1] != "router":
+            return P(axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def make_moe_ep_train_step(mesh, cfg, optimizer=None, aux_weight=1e-2,
+                           causal=False, attn_fn=None, axis=EXPERT_AXIS):
+    """-> (step_fn_factory, init_fn): MoE transformer training with real
+    expert parallelism.
+
+    Layout: sequences are batch-sharded over the ``experts`` mesh axis
+    (attention stays device-local, full T per sequence); each block's
+    expert stacks live sharded over the same axis and its FFN runs
+    ``switch_moe_ep`` (all_to_all dispatch).  Replicated params get their
+    gradient psum from AD's replicated->varying transpose, exactly like
+    the TP step's data axis.
+
+    step_fn(params, opt_state, x, y) -> (params, opt_state,
+    {"loss","nll","aux"}).  x: (batch, T, input_dim) global with
+    batch % mesh.shape[axis] == 0.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dist_keras_tpu.models.transformer import (
+        init_transformer_params,
+        layer_norm as _ln,
+    )
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    if not cfg.get("moe_experts", 0):
+        raise ValueError("make_moe_ep_train_step needs moe_experts > 0")
+    tx = optimizer or optax.adam(1e-3)
+    cf = cfg.get("moe_capacity_factor", 1.25)
+
+    if attn_fn is None:
+        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
+
+        attn = attention_auto
+    else:
+        attn = attn_fn
+
+    def forward(params, x):
+        import functools
+
+        from dist_keras_tpu.models.transformer import apply_block_aux
+
+        # the shared block definition, with the EP mixture injected; one
+        # pmean at the end instead of one per layer
+        moe_fn = functools.partial(switch_moe_ep, axis=axis,
+                                   capacity_factor=cf)
+        h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
+        aux = jnp.float32(0.0)
+        for blk in params["blocks"]:
+            h, a_loss = apply_block_aux(blk, h, attn, causal,
+                                        moe_fn=moe_fn)
+            aux = aux + a_loss
+        aux = lax.pmean(aux, axis)
+        pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
+        logits = (pooled @ params["head"]["kernel"]
+                  + params["head"]["bias"])
+        return logits, aux
+
+    def body(params, opt_state, x, y):
+        def loss_fn(p):
+            logits, aux = forward(p, x)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+            nll = lax.pmean(nll, axis)  # mean over the data shards
+            return nll + aux_weight * aux, (nll, aux)
+
+        (loss, (nll, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "nll": nll, "aux": aux}
+
+    def init_fn(seed=0):
+        params = init_transformer_params(jax.random.PRNGKey(seed), cfg)
+        return params, tx.init(params)
+
+    def step_fn_factory(params, opt_state):
+        from dist_keras_tpu.parallel.fsdp import match_specs_by_shape
+
+        pspecs = moe_transformer_param_specs(params, axis)
+        ospecs = match_specs_by_shape(params, pspecs, opt_state)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, ospecs, P(axis), P(axis)),
+            out_specs=(pspecs, ospecs, P()),
+        ))
+
+    return step_fn_factory, init_fn

@@ -137,7 +137,7 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         raise ValueError(
             "the Megatron TP step supports dense FFN blocks only; for "
             "MoE use make_moe_train_step (dense compute) or "
-            "switch_moe_ep (expert parallelism)")
+            "make_moe_ep_train_step (expert parallelism)")
     tx = optimizer or optax.adam(1e-3)
 
     def body(params, opt_state, x, y):

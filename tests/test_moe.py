@@ -163,3 +163,40 @@ def test_moe_transformer_trains():
 
     with pytest.raises(ValueError, match="aux"):
         transformer_apply(params, x, cfg)
+
+
+def test_moe_ep_transformer_step_trains_and_stays_sharded():
+    """Full MoE transformer training with REAL expert parallelism: expert
+    stacks sharded over the 8-device mesh, tokens batch-sharded, training
+    converges, and expert leaves stay physically 1/8-per-device."""
+    from dist_keras_tpu.models.transformer import transformer_config
+    from dist_keras_tpu.ops.attention import attention
+    from dist_keras_tpu.parallel.moe import make_moe_ep_train_step
+
+    # input_dim != moe_experts: optimizer-spec matching is by shape, and
+    # proj (input_dim, d) colliding with expert bias (E, d) is the
+    # documented ambiguity hard-error
+    cfg = transformer_config(input_dim=6, seq_len=12, d_model=32,
+                             n_heads=2, n_layers=2, n_classes=2,
+                             moe_experts=8, moe_capacity_factor=4.0)
+    mesh = _mesh(8)
+    factory, init_fn = make_moe_ep_train_step(
+        mesh, cfg, aux_weight=1e-2, attn_fn=attention)
+    params, opt_state = init_fn(0)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 12, 6)), jnp.float32)
+    y = jnp.asarray((np.asarray(x)[:, :, 0].mean(1) > 0).astype(np.int32))
+
+    fn = factory(params, opt_state)
+    first = None
+    for _ in range(30):
+        params, opt_state, m = fn(params, opt_state, x, y)
+        if first is None:
+            first = float(m["nll"])
+    assert float(m["nll"]) < first * 0.5, (first, float(m["nll"]))
+
+    w1 = params["blocks"][0]["moe"]["w1"]          # (8, d, ff)
+    assert np.prod(w1.addressable_shards[0].data.shape) == w1.size // 8
+    router = params["blocks"][0]["moe"]["router"]  # replicated
+    assert np.prod(router.addressable_shards[0].data.shape) == router.size
