@@ -147,14 +147,15 @@ class Dataset:
         return xb, yb
 
     def worker_shards(self, num_workers, batch_size, features_col="features",
-                      label_col="label", pad=True, worker_range=None):
+                      label_col="label", worker_range=None):
         """-> (num_workers, steps, batch, ...) arrays for shard_map training.
 
         Rows are dealt to workers contiguously (the reference's repartition
         deals Spark partitions to executors, trainers.py:~365).  Every worker
-        gets the same step count (lockstep SPMD needs rectangular data); with
-        ``pad`` the tail shard is padded by wrapping around, mirroring how
-        Spark balances partitions only approximately.
+        gets the same step count (lockstep SPMD needs rectangular data);
+        trailing rows beyond ``num_workers * steps * batch_size`` are
+        truncated, exactly like the reference's fixed mini-batch assembly
+        drops partial batches (workers.py:~60).
 
         ``worker_range=(lo, hi)`` materializes ONLY workers [lo, hi) —
         the multi-host path: every host computes the identical global

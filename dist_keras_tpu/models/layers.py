@@ -272,7 +272,8 @@ class _Pool2D(Layer):
         # while select-and-scatter routes it all to the first maximum;
         # both are valid subgradients but trajectories can differ on
         # quantized/replicated activations.
-        if ((sh, sw) == (ph, pw) and h % ph == 0 and w % pw == 0
+        if (jax.default_backend() == "cpu"
+                and (sh, sw) == (ph, pw) and h % ph == 0 and w % pw == 0
                 and self._np_reducer is not None):
             xr = x.reshape(n, h // ph, ph, w // pw, pw, c)
             return self._np_reducer(xr, axis=(2, 4))

@@ -68,12 +68,14 @@ def _route(params, x, num_experts, capacity):
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate = jnp.max(probs, axis=-1)                     # (N,)
     expert = jnp.argmax(probs, axis=-1)                # (N,)
-    onehot = jax.nn.one_hot(expert, num_experts,
-                            dtype=jnp.float32)         # (N, E)
-    # queue position of each token within its chosen expert
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # (N, E), 0-based
+    # queue position of each token within its chosen expert — int32
+    # cumsum: exact for any token count (float32 cumsum loses exactness
+    # past 2^24 tokens and would silently corrupt capacity assignment)
+    onehot_i = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    onehot = onehot_i.astype(jnp.float32)              # (N, E)
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - onehot_i  # (N, E)
     keep = (pos < capacity) * onehot                    # (N, E)
-    posc = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+    posc = jax.nn.one_hot(pos.sum(-1), capacity,
                           dtype=jnp.float32)            # (N, C)
     dispatch = keep[:, :, None] * posc[:, None, :]      # (N, E, C)
     combine = dispatch * gate[:, None, None]

@@ -160,8 +160,10 @@ class EnsembleTrainer(DistributedTrainer):
             xs, ys = self._shards(dataset)
         finally:
             self.num_workers = saved_workers
-        xs = xs.reshape(self.num_workers, mps, *xs.shape[1:])
-        ys = ys.reshape(self.num_workers, mps, *ys.shape[1:])
+        # -1, not self.num_workers: on multi-host _shards returns only
+        # this host's slots, so the leading dim is the LOCAL slot count
+        xs = xs.reshape(-1, mps, *xs.shape[1:])
+        ys = ys.reshape(-1, mps, *ys.shape[1:])
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
 
