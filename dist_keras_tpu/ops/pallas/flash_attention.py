@@ -115,12 +115,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(diag_visible)
     def _tile():
-        q = q_ref[0].astype(jnp.float32)            # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)            # (BK, D)
-        v = v_ref[0].astype(jnp.float32)            # (BK, D)
+        # keep tiles in their input dtype (bf16 on the trainer path): the
+        # MXU runs bf16 x bf16 -> f32-accumulate at full rate, while
+        # upcasting inputs to f32 first would force the ~3x slower f32
+        # matmul path.  All reductions/softmax state stay f32.
+        q = q_ref[0]                                 # (BQ, D)
+        k = k_ref[0]                                 # (BK, D)
+        v = v_ref[0]                                 # (BK, D)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale    # (BQ, BK)
+            preferred_element_type=jnp.float32) * scale    # (BQ, BK) f32
         if causal:
             logits = _causal_mask(logits, qi, ki, block_q, block_k,
                                   q_offset, kv_offset)
@@ -130,11 +134,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # exp(logits - m_new) would be exp(0) = 1 per masked entry —
         # shift by 0 instead so those p rows underflow to exactly 0
         safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(logits - safe_m)                 # (BQ, BK)
+        p = jnp.exp(logits - safe_m)                 # (BQ, BK) f32
         corr = jnp.exp(m_prev - m_new)
         l_scr[...] = l_scr[...] * corr + jnp.sum(p, -1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
@@ -203,10 +207,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
 
     @pl.when(diag_visible)
     def _tile():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 tiles straight into the MXU, f32 accumulation (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -216,13 +221,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
                                   q_offset, kv_offset)
         # dead rows carry lse == -1e30; exp(logits - lse) would be 1
         safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)
-        p = jnp.exp(logits - safe_lse)               # (BQ, BK)
+        p = jnp.exp(logits - safe_lse)               # (BQ, BK) f32
         dov = jax.lax.dot_general(                   # dO V^T
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dov + dl_ref[0].astype(jnp.float32))
         dq_scr[...] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -247,10 +252,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
 
     @pl.when(diag_visible)
     def _tile():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 tiles straight into the MXU, f32 accumulation (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -259,16 +265,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
             logits = _causal_mask(logits, qi, ki, block_q, block_k,
                                   q_offset, kv_offset)
         safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)  # dead rows
-        p = jnp.exp(logits - safe_lse)               # (BQ, BK)
+        p = jnp.exp(logits - safe_lse)               # (BQ, BK) f32
         dv_scr[...] += jax.lax.dot_general(          # P^T dO
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dov = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dov + dl_ref[0].astype(jnp.float32))
         dk_scr[...] += scale * jax.lax.dot_general(  # dS^T Q
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)

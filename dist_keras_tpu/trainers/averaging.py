@@ -22,6 +22,7 @@ from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.step import make_model_step
+from dist_keras_tpu.utils.sync import drain
 
 try:
     from jax import shard_map
@@ -82,6 +83,7 @@ class AveragingTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
+        drain(xs, ys)  # data distribution completes OUTSIDE the clock
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -92,7 +94,7 @@ class AveragingTrainer(DistributedTrainer):
             fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
             t0 = _time.time()
             params, losses = fn(params, xs, ys, key, jnp.int32(epochs_done))
-            jax.block_until_ready(params)
+            drain(params)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
             epochs_done += E
             losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)
@@ -214,6 +216,7 @@ class EnsembleTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
+        drain(xs, ys)  # data distribution completes OUTSIDE the clock
         key = jax.random.PRNGKey(self.seed)
         # xs: (slots, mps, steps, batch, ...)
         samples_per_epoch = (xs.shape[0] * xs.shape[1] * xs.shape[2]
@@ -227,7 +230,7 @@ class EnsembleTrainer(DistributedTrainer):
             t0 = _time.time()
             stacked, opt_state, losses = fn(
                 stacked, opt_state, xs, ys, key, jnp.int32(epochs_done))
-            jax.block_until_ready(stacked)
+            drain(stacked)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
             epochs_done += E
             # (slots, mps, E, steps) -> (num_models, E, steps)

@@ -34,6 +34,7 @@ from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import tree_merge_floats, tree_zeros_like
+from dist_keras_tpu.utils.sync import drain
 
 try:
     from jax import shard_map
@@ -178,6 +179,7 @@ class DynSGD(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
+        drain(xs, ys)  # data distribution completes OUTSIDE the clock
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -191,7 +193,7 @@ class DynSGD(DistributedTrainer):
              losses) = fn(center, pulled, local, opt_state, last_seen,
                           global_count, xs, ys, key,
                           jnp.int32(epochs_done))
-            jax.block_until_ready(center)
+            drain(center)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
             epochs_done += E
             losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)

@@ -14,6 +14,7 @@ import numpy as np
 
 from dist_keras_tpu.trainers.base import Trainer
 from dist_keras_tpu.trainers.step import make_model_step, scan_epoch
+from dist_keras_tpu.utils.sync import drain
 
 
 class SingleTrainer(Trainer):
@@ -39,33 +40,49 @@ class SingleTrainer(Trainer):
             opt_state = restored["opt_state"]
             rng = jnp.asarray(restored["rng"])
 
-        def build():
+        def build_chunk(E):
+            # E epochs inside ONE dispatch (outer scan over epochs, inner
+            # scan over batches) — the same whole-run-compiled shape as
+            # the distributed trainers; per-epoch host dispatch capped
+            # SingleTrainer at ~90k samples/s on a v5e
             @jax.jit
-            def run_epoch(params, opt_state, rng, xb, yb):
-                return scan_epoch(step, params, opt_state, rng, xb, yb)
+            def run(params, opt_state, rng, xb, yb):
+                def epoch(carry, _):
+                    params, opt_state, rng = carry
+                    params, opt_state, rng, ls = scan_epoch(
+                        step, params, opt_state, rng, xb, yb)
+                    return (params, opt_state, rng), ls
 
-            return run_epoch
+                (params, opt_state, rng), ls = jax.lax.scan(
+                    epoch, (params, opt_state, rng), None, length=E)
+                return params, opt_state, rng, ls  # ls: (E, steps)
 
-        run_epoch = self._compiled(build)
+            return run
 
         xb = jnp.asarray(xb)
         yb = jnp.asarray(yb)
+        drain(xb, yb)  # data distribution completes OUTSIDE the clock
         samples_per_epoch = xb.shape[0] * self.batch_size
 
         self.record_training_start()
         losses = []
-        for e in range(start_epoch, self.num_epoch):
+        epochs_done = start_epoch
+        for E in self._chunk_plan(start_epoch):
+            run = self._compiled(lambda: build_chunk(E), extra_key=(E,))
             t0 = _time.time()
-            params, opt_state, rng, ls = run_epoch(
+            params, opt_state, rng, ls = run(
                 params, opt_state, rng, xb, yb)
-            jax.block_until_ready(params)
+            drain(params)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
-            ls = np.asarray(ls)
-            losses.append(ls)
-            self._emit_epoch_end(e + 1, ls, dt, samples_per_epoch)
+            epochs_done += E
+            ls = np.asarray(ls)  # (E, steps)
+            losses.append(ls.reshape(-1))
+            self._emit_epoch_end(epochs_done, ls, dt,
+                                 samples_per_epoch * E)
             self._maybe_checkpoint(
-                e + 1, lambda: {"params": params, "opt_state": opt_state,
-                                "rng": rng})
+                epochs_done,
+                lambda: {"params": params, "opt_state": opt_state,
+                         "rng": rng})
         self.record_training_end()
 
         history = (np.concatenate(losses).tolist() if losses else [])

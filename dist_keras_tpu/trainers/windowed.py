@@ -48,6 +48,7 @@ from dist_keras_tpu.utils.pytree import (
     tree_scale,
     tree_sub,
 )
+from dist_keras_tpu.utils.sync import drain
 
 try:
     from jax import shard_map
@@ -188,6 +189,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
+        drain(xs, ys)  # data distribution completes OUTSIDE the clock
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = self.num_workers * windows * W * self.batch_size
 
@@ -200,7 +202,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             center, local, opt_state, losses = fn(
                 center, local, opt_state, xs, ys, key,
                 jnp.int32(epochs_done))
-            jax.block_until_ready(center)
+            drain(center)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
             epochs_done += E
             losses = np.asarray(comm.fetch_global(losses))  # (workers, E, windows, W)

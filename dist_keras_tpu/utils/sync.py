@@ -1,0 +1,57 @@
+"""Device-queue draining for honest wall-clock timing.
+
+The reference times training with plain wall clocks around the Spark job
+(``distkeras/trainers.py:~60``).  Our trainers do the same around the
+compiled dispatch — but JAX dispatch and device transfers are
+asynchronous, and on remote-tunnel backends (the ``axon`` TPU transport)
+``jax.block_until_ready`` can return before the device has actually
+finished: measured on this image, a 24-epoch compiled chunk "completed"
+in 1 ms by ``block_until_ready`` but took 1.37 s by a data-dependent
+readback.  Conversely, an async H2D transfer issued *before* the timed
+window silently completes *inside* it, charging seconds of PCIe/tunnel
+time to "training".
+
+``drain`` closes both holes with a one-element readback per leaf: a
+readback is a data-dependent RPC that cannot return until the producing
+transfer or computation has really run on the device.  Trainers call it
+
+- on the input batches after ``_to_device`` and BEFORE
+  ``record_training_start`` — data distribution is not training time
+  (the reference's analogue, Spark repartitioning, happens before its
+  workers start training too);
+- on the output params INSIDE the per-chunk timing window — so the
+  recorded seconds cover all compute the chunk actually did.
+
+Cost: one tiny fetch per leaf (first addressable shard only) — ~1.5 ms
+per leaf through the tunnel, microseconds locally; negligible against
+multi-second chunks and identical across benchmark runs.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def drain(*trees):
+    """Block until every pending computation/transfer producing the given
+    pytrees' leaves has completed on their devices.
+
+    Returns the number of readbacks performed.  Non-device leaves (numpy
+    arrays, python scalars) are skipped — they have nothing pending.
+    EVERY addressable shard of every leaf is fetched (one element each):
+    per-device queues are in-order but there is no cross-device ordering,
+    so draining only one device's shard would leave the other devices'
+    transfers free to complete inside a subsequent timed window.
+    """
+    count = 0
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards:
+                continue
+            for shard in shards:
+                data = shard.data
+                np.asarray(data[(0,) * data.ndim])
+                count += 1
+    return count
