@@ -59,25 +59,41 @@ def _shardings(mesh, specs):
         is_leaf=lambda s: isinstance(s, P))
 
 
-def match_specs_by_shape(params, pspecs, tree):
-    """Spec pytree for ``tree``: each leaf inherits the spec of the param
-    with the same global shape (optimizer states mirror params
-    leaf-for-leaf); shapes without a param counterpart replicate.
-    Conflicting specs for one shape are ambiguous -> hard error.  Shared
-    by FSDP and the TP step (transformer_tp._opt_specs)."""
-    shape_to_spec = {}
-    for arr, sp in zip(
-            jax.tree.leaves(params),
+def match_specs_for_state(params, pspecs, tree):
+    """Spec pytree for ``tree`` (an optimizer-state template): each leaf
+    inherits the spec of the param whose tree path is a *suffix* of the
+    leaf's own path with a matching shape.
+
+    Optimizer states embed the param tree structurally (adam's mu/nu,
+    sgd's momentum trace are each a copy of the param pytree nested
+    inside the state object), so the param path appears verbatim at the
+    tail of the state leaf's path — structural matching identifies the
+    right spec even when many params share one shape (d x d attention
+    projections, ``pos`` vs ``w2`` at (seq, d), ...), which pure
+    shape-keying could not disambiguate.  The longest matching suffix
+    wins; leaves with no param-path suffix (step counters, schedule
+    state) replicate.  Shared by FSDP, the TP step and the EP step."""
+    by_path = {}
+    for (path, arr), sp in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
             jax.tree.leaves(pspecs, is_leaf=lambda s: isinstance(s, P))):
-        shape = tuple(np.shape(arr))
-        if shape in shape_to_spec and shape_to_spec[shape] != sp:
-            raise ValueError(
-                f"ambiguous sharding for shape {shape}: "
-                f"{shape_to_spec[shape]} vs {sp}; choose distinct "
-                "dimension sizes")
-        shape_to_spec[shape] = sp
-    return jax.tree.map(
-        lambda leaf: shape_to_spec.get(tuple(np.shape(leaf)), P()), tree)
+        by_path[tuple(path)] = (tuple(np.shape(arr)), sp)
+
+    def spec_for(path, leaf):
+        shape = tuple(np.shape(leaf))
+        for start in range(len(path)):  # longest suffix first
+            hit = by_path.get(tuple(path[start:]))
+            if hit is not None and hit[0] == shape:
+                return hit[1]
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(path, leaf) for path, leaf in flat])
+
+
+# round-2 name; the shape-keyed implementation it refers to is gone
+match_specs_by_shape = match_specs_for_state
 
 
 def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
@@ -110,7 +126,7 @@ def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
         full unsharded state would be the exact OOM FSDP exists to
         avoid."""
         template = jax.eval_shape(tx.init, params)
-        specs = match_specs_by_shape(params, pspecs, template)
+        specs = match_specs_for_state(params, pspecs, template)
         return jax.tree.map(
             lambda s: NamedSharding(mesh_, s), specs,
             is_leaf=lambda s: isinstance(s, P))

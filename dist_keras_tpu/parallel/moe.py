@@ -288,10 +288,10 @@ def make_moe_ep_train_step(mesh, cfg, optimizer=None, aux_weight=1e-2,
         return params, tx.init(params)
 
     def step_fn_factory(params, opt_state):
-        from dist_keras_tpu.parallel.fsdp import match_specs_by_shape
+        from dist_keras_tpu.parallel.fsdp import match_specs_for_state
 
         pspecs = moe_transformer_param_specs(params, axis)
-        ospecs = match_specs_by_shape(params, pspecs, opt_state)
+        ospecs = match_specs_for_state(params, pspecs, opt_state)
         return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(pspecs, ospecs, P(axis), P(axis)),

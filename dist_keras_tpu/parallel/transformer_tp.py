@@ -170,12 +170,12 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         return params, opt_state
 
     def step_fn_factory(params, opt_state):
-        from dist_keras_tpu.parallel.fsdp import match_specs_by_shape
+        from dist_keras_tpu.parallel.fsdp import match_specs_for_state
 
         pspecs = param_specs(params)
-        # optimizer leaves inherit the same-shape param's spec (adam's
-        # mu/nu mirror the tree); ambiguous shapes hard-error
-        ospecs = match_specs_by_shape(params, pspecs, opt_state)
+        # optimizer leaves inherit their mirrored param's spec by tree
+        # path (adam's mu/nu embed the param tree)
+        ospecs = match_specs_for_state(params, pspecs, opt_state)
         data_x = P(WORKER_AXIS, SEQ_AXIS, None)
         data_y = P(WORKER_AXIS)
         return jax.jit(shard_map(
