@@ -29,6 +29,8 @@ from dist_keras_tpu.parallel.moe import (
 from dist_keras_tpu.parallel.pipeline import (
     PIPE_AXIS,
     gpipe_apply,
+    pipeline_1f1b,
+    pp_transformer_1f1b_grads,
     pp_transformer_apply,
     stack_blocks,
 )
@@ -41,4 +43,5 @@ __all__ = [
     "switch_moe_dense", "switch_moe_ep", "make_moe_train_step",
     "make_moe_ep_train_step", "moe_transformer_param_specs",
     "PIPE_AXIS", "gpipe_apply", "pp_transformer_apply", "stack_blocks",
+    "pipeline_1f1b", "pp_transformer_1f1b_grads",
 ]

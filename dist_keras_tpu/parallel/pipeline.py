@@ -1,4 +1,5 @@
-"""Pipeline parallelism (PP) — GPipe schedule over a ``stages`` mesh axis.
+"""Pipeline parallelism (PP) — GPipe and 1F1B schedules over a ``stages``
+mesh axis.
 
 New capability surface: the reference has no model partitioning of any
 kind (SURVEY.md §2.3).  This implements the TPU-idiomatic version: layers
@@ -8,17 +9,35 @@ the pipeline with ONE ``ppermute`` per tick (activations hop to the next
 stage over ICI), all inside a single jitted ``shard_map`` + ``lax.scan``
 — the schedule is compiled, not orchestrated from the host.
 
-Schedule: GPipe fill-drain.  T = M + P - 1 ticks; stage s processes
-microbatch m at tick t = m + s.  Bubble fraction = (P-1)/(M+P-1), so use
-M >> P.  Stages must be shape-preserving (x -> x of the same shape),
+Two schedules:
+
+- ``gpipe_apply`` — GPipe fill-drain forward.  T = M + P - 1 ticks; stage
+  s processes microbatch m at tick t = m + s.  Bubble fraction =
+  (P-1)/(M+P-1), so use M >> P.  Backward is plain autodiff (the
+  scan/ppermute transpose to the reverse schedule automatically), which
+  stores one stashed activation set per tick — O(M) microbatches live at
+  the backward's start.  Carries are PYTREES: any structure-preserving
+  ``stage_fn`` works, which is how the MoE router's aux loss rides
+  through the pipe (an extra scalar-per-microbatch leaf in the carry).
+- ``pipeline_1f1b`` — 1F1B (PipeDream-flush style): each tick runs one
+  microbatch forward AND one microbatch backward per stage, with the
+  backward implemented manually (activation-recompute vjp, the same
+  trade as ``jax.checkpoint``).  Peak activation stash is
+  min(M, 2P-1) microbatches — bounded by the pipeline depth, not the
+  microbatch count: the long-batch memory lever GPipe lacks.
+
+Stages must be shape-preserving (tree -> tree of the same structure),
 which transformer blocks are; embedding/head stay outside the pipelined
 region (replicated compute).
 
 ``gpipe_apply`` is the generic engine; ``pp_transformer_apply`` runs the
 standard ``models/transformer.py`` parameter pytree with its blocks
 sharded over stages — the single-device ``transformer_apply`` is the
-parity oracle (tests).  Backward is plain autodiff: the scan/ppermute
-transpose to the reverse schedule automatically.
+parity oracle (tests).  MoE blocks are supported: the router aux loss is
+accumulated per microbatch in the carry, and the pipelined total is the
+mean of per-microbatch aux (the router statistics are computed per
+microbatch — the natural PP x MoE semantics; the oracle for tests is
+the microbatched single-device forward).
 """
 
 from __future__ import annotations
@@ -30,24 +49,45 @@ from jax import lax
 PIPE_AXIS = "stages"
 
 
-def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS):
+def _tree_where(cond, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,
+                collect_fn=None):
     """Run a P-stage pipeline — call INSIDE shard_map with ``axis`` bound.
 
-    stage_fn(stage_params, x_mb) -> y_mb, shape-preserving.
+    stage_fn(stage_params, x_mb) -> y_mb, structure- and shape-preserving
+    over a pytree of microbatch leaves.
     stage_params: this device's stage parameters.
-    x: the FULL local batch (B, ...); split into ``num_microbatches``
-    along dim 0 (B % num_microbatches == 0).  Only stage 0 consumes it;
-    other devices receive activations over ICI.  Returns the full batch
-    output (valid on every device via a final psum).
+    x: pytree whose leaves are the FULL local batch ``(B, ...)``; split
+    into ``num_microbatches`` along dim 0 (B % num_microbatches == 0).
+    Only stage 0 consumes it; other devices receive activations over ICI.
+
+    collect_fn(y_mb) -> out_mb (any structure) reduces each finished
+    microbatch AT THE LAST STAGE before it is broadcast — pass the
+    pooling/readout here so the final psum moves the reduced tensor
+    (e.g. (mb, d)), not the full activations (mb, T, d).
+
+    Returns: with ``collect_fn=None``, the full-batch output tree
+    (leaves ``(B, ...)``, microbatches re-merged) — the legacy contract.
+    With a ``collect_fn``, the stacked per-microbatch collected tree
+    (leaves ``(M, ...)``).  Valid on every device via a psum over the
+    stage axis.
     """
     p = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     m = num_microbatches
-    b = x.shape[0]
+    b = jax.tree.leaves(x)[0].shape[0]
     if b % m:
         raise ValueError(f"batch {b} not divisible into {m} microbatches")
     mb = b // m
-    xs = x.reshape(m, mb, *x.shape[1:])
+    xs = jax.tree.map(lambda a: a.reshape(m, mb, *a.shape[1:]), x)
+
+    if collect_fn is None:
+        collect = lambda y: y  # noqa: E731
+    else:
+        collect = collect_fn
 
     perm_fwd = [(i, i + 1) for i in range(p - 1)]
 
@@ -55,24 +95,36 @@ def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS):
         buf, outs = carry
         # stage 0 feeds microbatch t while t < m (clip keeps indexing
         # static-shaped; the garbage tail microbatches never reach outs)
-        feed = xs[jnp.clip(t, 0, m - 1)]
-        inp = jnp.where(idx == 0, feed, buf)
+        feed = jax.tree.map(lambda a: a[jnp.clip(t, 0, m - 1)], xs)
+        inp = _tree_where(idx == 0, feed, buf)
         y = stage_fn(stage_params, inp)
         # activations hop to the next stage; the last stage's output
         # leaves the pipe here instead
-        buf_next = lax.ppermute(y, axis, perm_fwd)
+        buf_next = jax.tree.map(lambda l: lax.ppermute(l, axis, perm_fwd),
+                                y)
+        c = collect(y)
         mi = t - (p - 1)  # microbatch finishing at the last stage
         take = jnp.logical_and(idx == p - 1, mi >= 0)
         slot = jnp.clip(mi, 0, m - 1)
-        cur = lax.dynamic_index_in_dim(outs, slot, keepdims=False)
-        upd = jnp.where(take, y, cur)
-        outs = lax.dynamic_update_index_in_dim(outs, upd, slot, 0)
+
+        def put(outs_l, c_l):
+            cur = lax.dynamic_index_in_dim(outs_l, slot, keepdims=False)
+            upd = jnp.where(take, c_l, cur)
+            return lax.dynamic_update_index_in_dim(outs_l, upd, slot, 0)
+
+        outs = jax.tree.map(put, outs, c)
         return (buf_next, outs), None
 
     from dist_keras_tpu.parallel.collectives import tree_pvary
 
-    buf0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
-    outs0 = jnp.zeros((m, mb, *x.shape[1:]), x.dtype)
+    feed0 = jax.tree.map(lambda a: a[0], xs)
+    buf0 = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype), feed0)
+    # probe the collected output's shape with an axis-varying input — the
+    # real stage input is always varying (it mixes in the ppermuted buf)
+    c_shape = jax.eval_shape(
+        lambda: collect(stage_fn(stage_params, tree_pvary(feed0, axis))))
+    outs0 = jax.tree.map(
+        lambda s: jnp.zeros((m, *s.shape), s.dtype), c_shape)
     # the carry varies over the pipe axis (buf via ppermute, outs via the
     # idx mask) — cast the zero init to varying so the scan carry type is
     # stable under check_vma
@@ -80,11 +132,176 @@ def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS):
     outs0 = tree_pvary(outs0, axis)
     (buf, outs), _ = lax.scan(tick, (buf0, outs0),
                               jnp.arange(m + p - 1))
-    # only the last stage holds real outputs; broadcast to all stages so
-    # the head/loss can run replicated
-    outs = jnp.where(idx == p - 1, outs, 0.0)
-    outs = lax.psum(outs, axis)
-    return outs.reshape(b, *x.shape[1:])
+    # only the last stage holds real outputs; broadcast the COLLECTED
+    # (reduced) tree to all stages so the head/loss can run replicated
+    outs = jax.tree.map(
+        lambda l: lax.psum(jnp.where(idx == p - 1, l, jnp.zeros_like(l)),
+                           axis), outs)
+    if collect_fn is None:
+        return jax.tree.map(
+            lambda l: l.reshape(m * mb, *l.shape[2:]), outs)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: memory-bounded interleaved schedule with a manual backward
+# ---------------------------------------------------------------------------
+def pipeline_1f1b(stage_fn, stage_params, h, num_microbatches, last_fn,
+                  axis=PIPE_AXIS, aux_ct=0.0, first_fn=None):
+    """1F1B pipeline: forward AND backward in one interleaved schedule —
+    call INSIDE shard_map with ``axis`` bound.
+
+    Schedule: at tick t, stage s forwards microbatch ``t - s`` and
+    backwards microbatch ``t - (2P-2-s)`` (each when in range); the last
+    stage turns a microbatch around the same tick its forward completes.
+    T = M + 2P - 2 ticks.  A stage stashes only the microbatch INPUTS
+    still awaiting their backward — at most ``min(M, 2P-1)`` of them, the
+    1F1B memory bound — and recomputes the stage forward inside
+    ``jax.vjp`` at backward time (the ``jax.checkpoint`` trade: one extra
+    forward buys O(M) -> O(P) activation memory).  GPipe-by-autodiff
+    stores one activation set per tick = O(M) microbatches.
+
+    stage_fn(stage_params, h_mb) -> (h_out, aux_scalar): shape-preserving
+      activations plus this stage's per-microbatch auxiliary loss (0.0
+      for dense stages; the MoE router's load-balancing term).
+    last_fn(h_mb, mi) -> (loss, dh, extras): the head + loss on a
+      finished microbatch at the LAST stage.  ``loss`` a scalar, ``dh``
+      its cotangent w.r.t. ``h_mb``, ``extras`` any pytree to accumulate
+      (e.g. head-parameter gradients).  Runs masked on other stages.
+    first_fn(dh_mb, mi) -> extras pytree: consumes microbatch ``mi``'s
+      input cotangent AT STAGE 0 as soon as its backward completes —
+      put the (replicated) embedding's vjp here so its parameter grads
+      accumulate per microbatch and the engine never stores the O(M)
+      input-cotangent buffer.  Runs masked on other stages.
+
+    VJP-inside-shard_map caveat for both hooks: differentiate w.r.t. an
+    axis-VARYING (``pvary``'d) copy of any replicated parameters you
+    close over.  The transpose of a replicated->varying promotion is an
+    automatic psum over the axis, which would fold the other stages'
+    masked-out garbage cotangents into your gradients BEFORE the
+    engine's stage mask can exclude them (the engine psums the masked
+    accumulators itself at the end).
+    h: (B, ...) pre-pipeline activations (the replicated embedding
+      output); B % num_microbatches == 0.
+    aux_ct: weight of the summed aux losses in the objective — the vjp
+      cotangent fed to each stage's aux output.
+
+    Objective = sum_mb loss_mb + aux_ct * sum_{stage, mb} aux — callers
+    scale by 1/M as needed.
+
+    Returns ``(loss_sum, aux_sum, stage_grads, last_extras,
+    first_extras)``: loss_sum/aux_sum replicated scalars; stage_grads
+    this stage's parameter cotangents (axis-varying); last_extras /
+    first_extras the psums of the accumulated ``last_fn`` / ``first_fn``
+    extras (replicated — nonzero contributions come only from the last /
+    first stage respectively).
+    """
+    p = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = num_microbatches
+    b = h.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    mb = b // m
+    hs = h.reshape(m, mb, *h.shape[1:])
+    depth = min(m, 2 * p - 1)  # stash bound: max fwd->bwd lifetime + 1
+
+    perm_fwd = [(i, i + 1) for i in range(p - 1)]
+    perm_bwd = [(i + 1, i) for i in range(p - 1)]
+
+    if first_fn is None:
+        first_fn = lambda dh_mb, mi: {}  # noqa: E731
+
+    from dist_keras_tpu.parallel.collectives import tree_pvary
+
+    h0 = hs[0]
+    # probe with axis-varying zeros: the hooks always see varying values
+    probe = tree_pvary(jnp.zeros_like(h0), axis)
+    extras_shape = jax.eval_shape(lambda hm: last_fn(hm, 0)[2], probe)
+    fextras_shape = jax.eval_shape(lambda dh: first_fn(dh, 0), probe)
+
+    def tick(carry, t):
+        (fbuf, bbuf, stash, gacc, loss_acc, aux_acc,
+         extras_acc, fextras_acc) = carry
+
+        # ---- forward slot: stage s forwards microbatch t - s ----
+        mf = t - idx
+        fvalid = jnp.logical_and(mf >= 0, mf < m)
+        mf_c = jnp.clip(mf, 0, m - 1)
+        feed = hs[mf_c]
+        x_in = jnp.where(idx == 0, feed, fbuf)
+        y, _ = stage_fn(stage_params, x_in)
+        fbuf_next = lax.ppermute(y, axis, perm_fwd)
+        # stash the stage INPUT for the recompute-vjp at backward time
+        fslot = mf_c % depth
+        cur = lax.dynamic_index_in_dim(stash, fslot, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(fvalid, x_in, cur), fslot, 0)
+
+        # ---- backward slot: stage s backwards microbatch
+        #      t - (2P-2-s); at the last stage that is the microbatch
+        #      whose forward just finished this tick ----
+        mbk = t - (2 * p - 2 - idx)
+        bvalid = jnp.logical_and(mbk >= 0, mbk < m)
+        mbk_c = jnp.clip(mbk, 0, m - 1)
+        loss_mb, dy, extras = last_fn(y, mbk_c)
+        at_last = jnp.logical_and(bvalid, idx == p - 1)
+        loss_acc = loss_acc + jnp.where(at_last, loss_mb, 0.0)
+        extras_acc = jax.tree.map(
+            lambda e, d: e + jnp.where(at_last, d, jnp.zeros_like(d)),
+            extras_acc, extras)
+        dh_in = jnp.where(idx == p - 1, dy, bbuf)
+
+        x_st = lax.dynamic_index_in_dim(stash, mbk_c % depth,
+                                        keepdims=False)
+        (y2, aux2), vjp_fn = jax.vjp(stage_fn, stage_params, x_st)
+        # the aux cotangent must carry the same varying-axes set as the
+        # aux primal (stage_fns may return either an invariant constant
+        # or a varying router loss)
+        aux_cot = jnp.asarray(aux_ct, aux2.dtype)
+        vma = getattr(jax.typeof(aux2), "vma", None)
+        if vma:
+            aux_cot = lax.pvary(aux_cot, tuple(vma))
+        dparams, dx = vjp_fn((dh_in, aux_cot))
+        gacc = jax.tree.map(
+            lambda g, d: g + jnp.where(bvalid, d, jnp.zeros_like(d)),
+            gacc, dparams)
+        aux_acc = aux_acc + jnp.where(bvalid, aux2, 0.0)
+        dx = jnp.where(bvalid, dx, 0.0)
+        # stage 0's dx is the cotangent of hs[mbk] (the embedding
+        # output): feed it to first_fn (the embedding vjp) right away so
+        # no O(M) cotangent buffer ever exists
+        take0 = jnp.logical_and(bvalid, idx == 0)
+        fex = first_fn(dx, mbk_c)
+        fextras_acc = jax.tree.map(
+            lambda e, d: e + jnp.where(take0, d, jnp.zeros_like(d)),
+            fextras_acc, fex)
+        bbuf_next = lax.ppermute(dx, axis, perm_bwd)
+
+        return (fbuf_next, bbuf_next, stash, gacc, loss_acc,
+                aux_acc, extras_acc, fextras_acc), None
+
+    carry0 = (
+        jnp.zeros_like(h0),                                   # fbuf
+        jnp.zeros_like(h0),                                   # bbuf
+        jnp.zeros((depth, *h0.shape), h.dtype),               # stash
+        jax.tree.map(jnp.zeros_like, stage_params),           # gacc
+        jnp.float32(0.0),                                     # loss_acc
+        jnp.float32(0.0),                                     # aux_acc
+        jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                     extras_shape),                           # last extras
+        jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                     fextras_shape),                          # first extras
+    )
+    carry0 = tree_pvary(carry0, axis)
+    carry, _ = lax.scan(tick, carry0, jnp.arange(m + 2 * p - 2))
+    (_, _, _, gacc, loss_acc, aux_acc, extras_acc, fextras_acc) = carry
+
+    loss_sum = lax.psum(loss_acc, axis)   # nonzero on the last stage only
+    aux_sum = lax.psum(aux_acc, axis)     # every stage contributes
+    extras_sum = jax.tree.map(lambda e: lax.psum(e, axis), extras_acc)
+    fextras_sum = jax.tree.map(lambda e: lax.psum(e, axis), fextras_acc)
+    return loss_sum, aux_sum, gacc, extras_sum, fextras_sum
 
 
 # ---------------------------------------------------------------------------
@@ -97,23 +314,38 @@ def stack_blocks(blocks):
 
 
 def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
-                         causal=False, axis=PIPE_AXIS, attn_fn=None):
+                         causal=False, axis=PIPE_AXIS, attn_fn=None,
+                         with_aux=False):
     """Pipelined forward of ``models/transformer.py`` — call inside
     shard_map.  ``params``: the non-block parameters (proj/pos/ln_f/head),
     replicated; ``stacked_blocks``: this stage's (L_local, ...) block
     stack.  x: (B, T, input_dim) local batch.  Embedding and head run
     replicated on every stage (tiny); the L transformer blocks are the
-    pipelined region."""
+    pipelined region.
+
+    MoE blocks (``cfg["moe_experts"] > 0``) are supported: each
+    microbatch carries its accumulated router aux loss through the pipe
+    as an extra leaf, and the total aux returned is the MEAN over
+    microbatches (router statistics are per-microbatch under PP; the
+    test oracle is the microbatched single-device forward).  Pass
+    ``with_aux=True`` (mandatory for MoE configs) to get
+    ``(logits, aux)``.
+
+    The per-microbatch readout (final LN + mean-pool over tokens) runs
+    at the LAST stage via ``gpipe_apply``'s collect hook, so the
+    stage-axis broadcast moves (B, d_model) + scalars — not the full
+    (B, T, d_model) activations.
+    """
     from dist_keras_tpu.models.transformer import (
-        apply_block,
+        apply_block_aux,
         layer_norm as _ln,
     )
 
-    if cfg.get("moe_experts", 0):
+    moe = bool(cfg.get("moe_experts", 0))
+    if moe and not with_aux:
         raise ValueError(
-            "pipelined MoE blocks are not supported yet (the router aux "
-            "loss has no channel through the pipeline); use "
-            "make_moe_train_step")
+            "pipelined MoE configs must be called with with_aux=True so "
+            "the router's load-balancing loss reaches the objective")
 
     if attn_fn is None:
         # same dispatch as the single-device forward: Pallas flash kernel
@@ -122,15 +354,127 @@ def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
 
         attn_fn = attention_auto
 
+    cf = cfg.get("moe_capacity_factor", 1.25)
     h = x @ params["proj"] + params["pos"][None, :x.shape[1]]
+    aux0 = jnp.zeros((h.shape[0],), jnp.float32)
+
+    def stage_fn(stage_blocks, carry):
+        def body(c, blk):
+            hc, auxc = c
+            hc, a = apply_block_aux(blk, hc, attn_fn, causal, cf)
+            return (hc, auxc + a), None
+
+        c, _ = lax.scan(body, carry, stage_blocks)
+        return c
+
+    def collect(c):
+        h_mb, aux_mb = c
+        pooled = jnp.mean(_ln(params["ln_f"], h_mb), axis=1)  # (mb, d)
+        return pooled, jnp.mean(aux_mb)  # per-microbatch aux scalar
+
+    pooled, aux = gpipe_apply(stage_fn, stacked_blocks, (h, aux0),
+                              num_microbatches, axis, collect_fn=collect)
+    b = x.shape[0]
+    logits = (pooled.reshape(b, -1) @ params["head"]["kernel"]
+              + params["head"]["bias"])
+    if with_aux:
+        return logits, jnp.mean(aux)
+    return logits
+
+
+def pp_transformer_1f1b_grads(params, stacked_blocks, x, y, cfg,
+                              num_microbatches, causal=False,
+                              axis=PIPE_AXIS, attn_fn=None,
+                              aux_weight=1e-2):
+    """1F1B fwd+bwd of the transformer — call inside shard_map.
+
+    Computes the same objective as the MoE/TP train steps —
+    ``mean-over-batch nll + aux_weight * mean-over-microbatches router
+    aux`` (``aux_weight`` default matches ``make_moe_train_step``) — in
+    one interleaved 1F1B schedule with O(P) activation memory
+    (``pipeline_1f1b``).  The embedding vjp runs per microbatch at stage
+    0 (``first_fn``), the head + loss + their grads at the last stage
+    (``last_fn``); block grads stay stage-resident.
+
+    x: (B, T, input_dim); y: (B,) int labels.
+    Returns ``(loss, aux, rest_grads, block_grads)``: ``loss``/``aux``
+    the unweighted nll and mean router aux (combine as
+    ``loss + aux_weight * aux`` for the objective value — the returned
+    GRADIENTS already include the weighted aux term); ``rest_grads`` the
+    proj/pos/ln_f/head cotangents (replicated), ``block_grads`` this
+    stage's (L_local, ...) block cotangents (axis-varying).
+    """
+    from dist_keras_tpu.models.transformer import (
+        apply_block_aux,
+        layer_norm as _ln,
+    )
+    from dist_keras_tpu.parallel.collectives import tree_pvary
+
+    if attn_fn is None:
+        from dist_keras_tpu.ops.pallas.flash_attention import attention_auto
+
+        attn_fn = attention_auto
+
+    cf = cfg.get("moe_capacity_factor", 1.25)
+    m = num_microbatches
+    b, t = x.shape[0], x.shape[1]
+    mb = b // m
+    xs_r = x.reshape(m, mb, t, x.shape[2])
+    ys_r = y.reshape(m, mb)
+
+    h = x @ params["proj"] + params["pos"][None, :t]
 
     def stage_fn(stage_blocks, h_mb):
-        def body(h, blk):
-            return apply_block(blk, h, attn_fn, causal), None
+        def body(c, blk):
+            hc, auxc = c
+            hc, a = apply_block_aux(blk, hc, attn_fn, causal, cf)
+            return (hc, auxc + a), None
 
-        h_mb, _ = lax.scan(body, h_mb, stage_blocks)
-        return h_mb
+        # aux init must be axis-varying: the per-block aux (MoE router
+        # loss) is computed from varying blocks, so the scan carry type
+        # would otherwise flip invariant -> varying
+        (h_out, aux), _ = lax.scan(
+            body, (h_mb, tree_pvary(jnp.float32(0.0), axis)),
+            stage_blocks)
+        return h_out, aux
 
-    h = gpipe_apply(stage_fn, stacked_blocks, h, num_microbatches, axis)
-    pooled = jnp.mean(_ln(params["ln_f"], h), axis=1)
-    return pooled @ params["head"]["kernel"] + params["head"]["bias"]
+    def last_fn(h_mb, mi):
+        yt = ys_r[mi]
+
+        def f(head_ln, hm):
+            ln_f, head = head_ln
+            pooled = jnp.mean(_ln(ln_f, hm), axis=1)
+            logits = pooled @ head["kernel"] + head["bias"]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(
+                logp, yt[:, None].astype(jnp.int32), axis=-1).mean()
+            return nll / m  # engine sums over microbatches -> batch mean
+
+        # differentiate w.r.t. an axis-VARYING copy of the replicated
+        # head params: grads of a replicated value under shard_map get an
+        # automatic psum over the axis, which would fold the OTHER
+        # stages' masked-out garbage cotangents in before the engine's
+        # at-last-stage mask can exclude them
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1))(
+            tree_pvary((params["ln_f"], params["head"]), axis), h_mb)
+        return loss, grads[1], grads[0]
+
+    def first_fn(dh_mb, mi):
+        x_mb = xs_r[mi]
+
+        def emb(pe):
+            proj, pos = pe
+            return x_mb @ proj + pos[None, :t]
+
+        # vjp w.r.t. a varying copy — same reason as in last_fn
+        _, vjp_fn = jax.vjp(
+            emb, tree_pvary((params["proj"], params["pos"]), axis))
+        (d,) = vjp_fn(dh_mb)
+        return d  # (dproj, dpos)
+
+    loss, aux_sum, block_grads, (d_lnf, d_head), (d_proj, d_pos) = (
+        pipeline_1f1b(stage_fn, stacked_blocks, h, m, last_fn, axis,
+                      aux_ct=aux_weight / m, first_fn=first_fn))
+    rest_grads = {"proj": d_proj, "pos": d_pos, "ln_f": d_lnf,
+                  "head": d_head}
+    return loss, aux_sum / m, rest_grads, block_grads
