@@ -59,6 +59,44 @@ def _shardings(mesh, specs):
         is_leaf=lambda s: isinstance(s, P))
 
 
+def place_by_specs(mesh, tree, specs):
+    """Place every leaf of ``tree`` with its spec's NamedSharding.
+
+    Works single- AND multi-process: host values go through numpy so
+    each process contributes its addressable shards of the global array
+    from its (identical) host copy — the placement step sharded train
+    steps (TP/EP/FSDP) need before their first call on a multi-host
+    mesh, where a host-committed ``jnp.asarray`` is not a valid global
+    input.  Already-global (not fully addressable) arrays are resharded
+    through a jitted identity instead.  ``specs`` may be a pytree of
+    PartitionSpecs mirroring ``tree``'s structure, or a single spec
+    applied to every leaf.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if isinstance(specs, P):
+        spec_leaves = [specs] * len(leaves)
+    else:
+        spec_struct = jax.tree.structure(
+            specs, is_leaf=lambda s: isinstance(s, P))
+        if spec_struct != treedef:
+            raise ValueError(
+                f"specs structure {spec_struct} does not match tree "
+                f"structure {treedef}")
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda s: isinstance(s, P))
+
+    def _put(a, s):
+        sharding = NamedSharding(mesh, s)
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            return jax.jit(lambda t: t, out_shardings=sharding)(a)
+        if jax.process_count() == 1:
+            return jax.device_put(a, sharding)
+        return jax.device_put(np.asarray(a), sharding)
+
+    return jax.tree.unflatten(
+        treedef, [_put(a, s) for a, s in zip(leaves, spec_leaves)])
+
+
 def match_specs_for_state(params, pspecs, tree):
     """Spec pytree for ``tree`` (an optimizer-state template): each leaf
     inherits the spec of the param whose tree path is a *suffix* of the
@@ -112,8 +150,7 @@ def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,
 
     def init_fn(params):
         pspecs = fsdp_specs(params, axis_size, axis, min_shard_elems)
-        pshard = _shardings(mesh, pspecs)
-        params = jax.tree.map(jax.device_put, params, pshard)
+        params = place_by_specs(mesh, params, pspecs)
         opt_state = jax.jit(
             tx.init,
             out_shardings=_opt_shardings(params, pspecs, mesh))(params)
@@ -164,8 +201,8 @@ def train_fsdp(mesh, model_apply, loss_fn, params, x, y, steps=10,
         min_shard_elems=min_shard_elems)
     params, opt_state = init_fn(params)
     fn = factory(params, opt_state)
-    xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(WORKER_AXIS)))
-    yd = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P(WORKER_AXIS)))
+    xd = place_by_specs(mesh, x, P(WORKER_AXIS))
+    yd = place_by_specs(mesh, y, P(WORKER_AXIS))
     losses = []
     for _ in range(steps):
         params, opt_state, loss = fn(params, opt_state, xd, yd)

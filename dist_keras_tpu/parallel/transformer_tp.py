@@ -170,14 +170,7 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         return params, opt_state
 
     def step_fn_factory(params, opt_state):
-        from dist_keras_tpu.parallel.fsdp import match_specs_for_state
-
-        pspecs = param_specs(params)
-        # optimizer leaves inherit their mirrored param's spec by tree
-        # path (adam's mu/nu embed the param tree)
-        ospecs = match_specs_for_state(params, pspecs, opt_state)
-        data_x = P(WORKER_AXIS, SEQ_AXIS, None)
-        data_y = P(WORKER_AXIS)
+        pspecs, ospecs, data_x, data_y = tp_step_specs(params, opt_state)
         return jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(pspecs, ospecs, data_x, data_y),
@@ -185,6 +178,18 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
         ))
 
     return step_fn_factory, init_fn
+
+
+def tp_step_specs(params, opt_state):
+    """The TP step's argument PartitionSpecs — the single source of truth
+    shared by the compiled step's in_specs and host-side placement
+    (``train_tp_transformer``).  Optimizer leaves inherit their mirrored
+    param's spec by tree path (adam's mu/nu embed the param tree)."""
+    from dist_keras_tpu.parallel.fsdp import match_specs_for_state
+
+    pspecs = param_specs(params)
+    ospecs = match_specs_for_state(params, pspecs, opt_state)
+    return (pspecs, ospecs, P(WORKER_AXIS, SEQ_AXIS, None), P(WORKER_AXIS))
 
 
 def train_tp_transformer(mesh, cfg, x, y, steps=10, optimizer=None,
@@ -195,13 +200,22 @@ def train_tp_transformer(mesh, cfg, x, y, steps=10, optimizer=None,
     x: (N, seq_len, input_dim); y: (N,) int labels.  N must divide by the
     mesh's ``workers`` size and seq_len by its ``seq`` size.
     """
+    from dist_keras_tpu.parallel.fsdp import place_by_specs
+
     step_factory, init_fn = make_tp_train_step(
         mesh, cfg, optimizer=optimizer, causal=causal,
         compute_dtype=compute_dtype, remat=remat)
     params, opt_state = init_fn(seed)
     fn = step_factory(params, opt_state)
+    # explicit global placement so the loop also runs on a multi-host
+    # mesh (a host-committed jnp.asarray is not a valid global input);
+    # specs come from the same helper the compiled step's in_specs use
+    pspecs, ospecs, xspec, yspec = tp_step_specs(params, opt_state)
+    params = place_by_specs(mesh, params, pspecs)
+    opt_state = place_by_specs(mesh, opt_state, ospecs)
+    xd = place_by_specs(mesh, x, xspec)
+    yd = place_by_specs(mesh, y, yspec)
     losses = []
-    xd, yd = jnp.asarray(x), jnp.asarray(y)
     for _ in range(steps):
         params, opt_state, loss_val = fn(params, opt_state, xd, yd)
         losses.append(float(loss_val))

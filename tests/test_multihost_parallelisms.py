@@ -1,0 +1,231 @@
+"""2-process multi-host tests for the showcase parallelisms (VERDICT
+round-2 #5): TP (dp x tp x sp transformer), FSDP, and MoE-EP each train
+on a real 2-process jax.distributed CPU group (2 hosts x 4 devices) and
+must produce the same final weights as the single-process 8-device run.
+
+These catch the process-local-data assembly bugs the ADAG Gloo test
+(test_multihost.py §3) structurally can't: the TP/FSDP/EP steps take
+globally-sharded array arguments directly, so a host-committed
+``jnp.asarray`` where a global ``device_put`` is needed fails only here.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:%PORT%"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
+import numpy as np
+sys.path.insert(0, %REPO%)
+from dist_keras_tpu.comm import backend as comm
+comm.initialize()
+assert jax.process_count() == 2
+print("NPROC", jax.process_count(), flush=True)
+"""
+
+_EPILOGUE = r"""
+from jax.sharding import NamedSharding, PartitionSpec
+rep = NamedSharding(mesh, PartitionSpec())
+host = [np.asarray(
+    jax.jit(lambda a: a, out_shardings=rep)(l).addressable_shards[0].data)
+    for l in leaves]
+np.savez(%OUT% + f"_{pid}.npz", *host)
+print("DONE", pid, flush=True)
+"""
+
+
+def _tp_body():
+    return r"""
+import jax.numpy as jnp
+from dist_keras_tpu.models.transformer import transformer_config
+from dist_keras_tpu.parallel.transformer_tp import (
+    make_tp_mesh, train_tp_transformer)
+
+cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                         n_layers=1, n_classes=2)
+mesh = make_tp_mesh(dp=2, tp=2, sp=2)
+rng = np.random.default_rng(0)
+x = rng.normal(size=(8, 8, 4)).astype(np.float32)
+y = rng.integers(0, 2, 8).astype(np.int32)
+params, losses = train_tp_transformer(mesh, cfg, x, y, steps=3, seed=0)
+import jax
+leaves = jax.tree.leaves(params)
+"""
+
+
+def _fsdp_body():
+    return r"""
+import jax.numpy as jnp
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.ops.losses import get_loss
+from dist_keras_tpu.parallel.fsdp import train_fsdp
+from dist_keras_tpu.parallel.mesh import worker_mesh
+from dist_keras_tpu.utils.misc import one_hot
+
+mesh = worker_mesh(8)
+model = mnist_mlp(hidden=(32,), input_dim=16, num_classes=4, seed=0)
+loss_fn = get_loss("categorical_crossentropy")
+rng = np.random.default_rng(0)
+x = rng.normal(size=(32, 16)).astype(np.float32)
+y = one_hot(rng.integers(0, 4, 32), 4)
+params, losses = train_fsdp(
+    mesh, lambda p, xb: model.apply(p, xb), loss_fn, model.params,
+    x, y, steps=3, min_shard_elems=8)
+import jax
+leaves = jax.tree.leaves(params)
+"""
+
+
+def _ep_body():
+    return r"""
+import jax.numpy as jnp
+from dist_keras_tpu.models.transformer import transformer_config
+from dist_keras_tpu.parallel.moe import make_moe_ep_train_step
+from dist_keras_tpu.parallel.mesh import grid_mesh
+from dist_keras_tpu.parallel.moe import EXPERT_AXIS
+
+cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                         n_layers=1, n_classes=2, moe_experts=8,
+                         moe_capacity_factor=2.0)
+mesh = grid_mesh({EXPERT_AXIS: 8})
+factory, init_fn = make_moe_ep_train_step(mesh, cfg)
+params, opt_state = init_fn(0)
+fn = factory(params, opt_state)
+from jax.sharding import PartitionSpec as P
+from dist_keras_tpu.parallel.fsdp import (match_specs_for_state,
+                                          place_by_specs)
+from dist_keras_tpu.parallel.moe import moe_transformer_param_specs
+pspecs = moe_transformer_param_specs(params, EXPERT_AXIS)
+params = place_by_specs(mesh, params, pspecs)
+opt_state = place_by_specs(
+    mesh, opt_state, match_specs_for_state(params, pspecs, opt_state))
+rng = np.random.default_rng(0)
+x = place_by_specs(mesh, rng.normal(size=(16, 8, 4)).astype(np.float32),
+                   P(EXPERT_AXIS))
+y = place_by_specs(mesh, rng.integers(0, 2, 16).astype(np.int32),
+                   P(EXPERT_AXIS))
+for _ in range(3):
+    params, opt_state, metrics = fn(params, opt_state, x, y)
+import jax
+leaves = jax.tree.leaves(params)
+"""
+
+
+def _run_pair(tmp_path, body):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    out = str(tmp_path / "w")
+    script = ((_PRELUDE + body + _EPILOGUE)
+              .replace("%PORT%", str(port))
+              .replace("%REPO%", repr(REPO))
+              .replace("%OUT%", repr(out)))
+    path = tmp_path / "worker.py"
+    path.write_text(script)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")}
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen([sys.executable, str(path), str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for pid in (0, 1)]
+    outs = [p.communicate(timeout=540)[0] for p in procs]
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{o[-3000:]}"
+        assert "NPROC 2" in o, f"proc {pid} not multi-host:\n{o[-2000:]}"
+    return (np.load(out + "_0.npz"), np.load(out + "_1.npz"))
+
+
+def _assert_same(w0, w1, ref_leaves):
+    for k in w0.files:
+        np.testing.assert_allclose(w0[k], w1[k], atol=1e-6)
+    for a, k in zip(ref_leaves, w0.files):
+        np.testing.assert_allclose(
+            np.asarray(a), w0[k], atol=1e-5, rtol=1e-5)
+
+
+def test_two_process_tp_matches_single_process(tmp_path):
+    w0, w1 = _run_pair(tmp_path, _tp_body())
+
+    import jax
+
+    from dist_keras_tpu.models.transformer import transformer_config
+    from dist_keras_tpu.parallel.transformer_tp import (
+        make_tp_mesh,
+        train_tp_transformer,
+    )
+
+    cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                             n_layers=1, n_classes=2)
+    mesh = make_tp_mesh(dp=2, tp=2, sp=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 8, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 8).astype(np.int32)
+    params, _ = train_tp_transformer(mesh, cfg, x, y, steps=3, seed=0)
+    _assert_same(w0, w1, jax.tree.leaves(params))
+
+
+def test_two_process_fsdp_matches_single_process(tmp_path):
+    w0, w1 = _run_pair(tmp_path, _fsdp_body())
+
+    import jax
+
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.ops.losses import get_loss
+    from dist_keras_tpu.parallel.fsdp import train_fsdp
+    from dist_keras_tpu.parallel.mesh import worker_mesh
+    from dist_keras_tpu.utils.misc import one_hot
+
+    mesh = worker_mesh(8)
+    model = mnist_mlp(hidden=(32,), input_dim=16, num_classes=4, seed=0)
+    loss_fn = get_loss("categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = one_hot(rng.integers(0, 4, 32), 4)
+    params, _ = train_fsdp(
+        mesh, lambda p, xb: model.apply(p, xb), loss_fn, model.params,
+        x, y, steps=3, min_shard_elems=8)
+    _assert_same(w0, w1, jax.tree.leaves(params))
+
+
+def test_two_process_ep_matches_single_process(tmp_path):
+    w0, w1 = _run_pair(tmp_path, _ep_body())
+
+    import jax
+
+    from dist_keras_tpu.models.transformer import transformer_config
+    from dist_keras_tpu.parallel.mesh import grid_mesh
+    from dist_keras_tpu.parallel.moe import (
+        EXPERT_AXIS,
+        make_moe_ep_train_step,
+    )
+
+    cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                             n_layers=1, n_classes=2, moe_experts=8,
+                             moe_capacity_factor=2.0)
+    mesh = grid_mesh({EXPERT_AXIS: 8})
+    factory, init_fn = make_moe_ep_train_step(mesh, cfg)
+    params, opt_state = init_fn(0)
+    fn = factory(params, opt_state)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 16).astype(np.int32)
+    for _ in range(3):
+        params, opt_state, _m = fn(params, opt_state, x, y)
+    _assert_same(w0, w1, jax.tree.leaves(params))
