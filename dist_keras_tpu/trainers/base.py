@@ -81,8 +81,10 @@ class Trainer:
         Shapes by trainer: SingleTrainer -> (steps,); AveragingTrainer ->
         (workers, epochs, steps); EnsembleTrainer -> (num_models, epochs,
         steps); windowed family (DOWNPOUR/ADAG/AEASGD/EAMSGD) ->
-        (workers, epochs, windows, W); DynSGD -> (workers, epochs,
-        steps).
+        (workers, epochs, windows, W) — except a run RESUMED mid-epoch
+        (``checkpoint_every_windows``), whose partial first epoch makes
+        its own losses (workers, windows_run, W); DynSGD -> (workers,
+        epochs, steps).
         """
         return self.history
 

@@ -67,10 +67,20 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     """
 
     def __init__(self, keras_model, num_workers=2, communication_window=5,
-                 parallelism_factor=1, **kw):
+                 parallelism_factor=1, checkpoint_every_windows=None, **kw):
         super().__init__(keras_model, num_workers=num_workers, **kw)
         self.communication_window = int(communication_window)
         self.parallelism_factor = int(parallelism_factor)
+        # window-granular checkpoint cadence: a preemption then loses at
+        # most ``checkpoint_every_windows`` communication windows, not a
+        # whole epoch (the reference's big-DataFrame case,
+        # trainers.py:~360, can make one epoch arbitrarily long)
+        self.checkpoint_every_windows = (
+            int(checkpoint_every_windows) if checkpoint_every_windows
+            else None)
+        if self.checkpoint_every_windows and not self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_every_windows requires checkpoint_dir")
 
     def _cache_extras(self):
         # the per-chunk epoch count is appended via _compiled(extra_key=)
@@ -85,15 +95,55 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         the worker axis bound."""
         raise NotImplementedError
 
+    def _window_chunk_plan(self, start_w, total_w, wpe):
+        """Chunk sizes in WINDOW units: the dispatch breaks at the union
+        of epoch boundaries (when callbacks need on_epoch_end at real
+        epoch ends) and checkpoint-cadence boundaries (counted from the
+        resume point, possibly mid-epoch).  No hooks = one dispatch (the
+        round-1 perf path)."""
+        remaining = total_w - start_w
+        if remaining <= 0:
+            return []
+        bounds = {total_w}
+        if self.callbacks:
+            first = (start_w // wpe + 1) * wpe
+            bounds |= set(range(first, total_w, wpe))
+        cadence = (self.checkpoint_every_windows
+                   or (self.checkpoint_every * wpe
+                       if self.checkpoint_every else None))
+        if cadence:
+            bounds |= set(range(start_w + cadence, total_w, cadence))
+        cuts = sorted(b for b in bounds if start_w < b <= total_w)
+        out, prev = [], start_w
+        for b in cuts:
+            out.append(b - prev)
+            prev = b
+        return out
+
+    def _maybe_checkpoint_windows(self, windows_done, total_w, state_fn):
+        ckptr = self._checkpointer_or_none()
+        if ckptr is None:
+            return
+        last = getattr(self, "_last_ckpt_epoch", 0)  # in window units here
+        wpe = self._wpe
+        cadence = (self.checkpoint_every_windows
+                   or (self.checkpoint_every or self.num_epoch) * wpe)
+        if windows_done - last >= cadence or windows_done >= total_w:
+            ckptr.save(windows_done, state_fn())
+            self._last_ckpt_epoch = windows_done
+
     # --- shared training loop ------------------------------------------
     def train(self, dataset, shuffle=False):
-        """Epochs run as an outer ``lax.scan`` over device-resident shard
-        tensors (one H2D transfer).  With no hooks requested the whole
-        num_epoch run is ONE dispatch; ``checkpoint_every``/``callbacks``
-        chunk the dispatch at epoch boundaries, with all worker state
-        (local replicas, optimizer state) carried across chunks — exactly
-        as a long-lived reference worker's state persists
-        (workers.py:~150) — so training is resumable mid-run."""
+        """The whole run is one flat ``lax.scan`` over communication
+        windows on device-resident shard tensors (one H2D transfer).
+        With no hooks requested all ``num_epoch * windows_per_epoch``
+        windows are ONE dispatch; ``checkpoint_every``/``callbacks``
+        chunk at epoch boundaries and ``checkpoint_every_windows`` at
+        WINDOW boundaries — mid-epoch — with all worker state (local
+        replicas, optimizer state, the in-epoch rng) carried across
+        chunks, so a preemption loses at most one cadence of windows.
+        The reference analogue: a long-lived worker's state persists
+        across its entire partition pass (workers.py:~150)."""
         import time as _time
 
         model, loss_fn, tx = self._resolve()
@@ -103,7 +153,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
 
         W = min(self.communication_window, xs.shape[1])
-        windows = xs.shape[1] // W
+        wpe = xs.shape[1] // W  # windows per epoch
         # Whole windows only, cut per epoch (remainder dropped every epoch,
         # like the reference's fixed mini-batching) — warn so silent data
         # loss / window shrinkage is visible.
@@ -112,34 +162,46 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 f"communication_window={self.communication_window} > "
                 f"{xs.shape[1]} steps per worker per epoch; effective "
                 f"window shrunk to {W}", stacklevel=2)
-        dropped = xs.shape[1] - windows * W
+        dropped = xs.shape[1] - wpe * W
         if dropped:
             warnings.warn(
                 f"dropping {dropped} trailing step(s) per worker per epoch "
                 f"(not a whole communication window)", stacklevel=2)
         # leading axis is LOCAL workers (== num_workers single-process;
         # this host's slice when multi-host, see base._shards)
-        xs = xs[:, :windows * W].reshape(
-            xs.shape[0], windows, W, *xs.shape[2:])
-        ys = ys[:, :windows * W].reshape(
-            ys.shape[0], windows, W, *ys.shape[2:])
+        xs = xs[:, :wpe * W].reshape(xs.shape[0], wpe, W, *xs.shape[2:])
+        ys = ys[:, :wpe * W].reshape(ys.shape[0], wpe, W, *ys.shape[2:])
+        self._wpe = wpe
+        total_w = self.num_epoch * wpe
 
         mesh = self.mesh
         merge = self.merge
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
 
-        def build_chunk(E):
-            def body(center, local, opt_state, xs, ys, key, epoch0):
-                xs, ys = xs[0], ys[0]  # (windows, W, batch, ...)
+        def build_chunk(K):
+            def body(center, local, opt_state, rng, xs, ys, key, g0):
+                xs, ys = xs[0], ys[0]  # (wpe, W, batch, ...)
                 widx = jax.lax.axis_index(WORKER_AXIS)
                 # carry state arrives stacked (1, ...) per worker shard
                 local = jax.tree.map(lambda t: t[0], local)
                 opt_state = jax.tree.map(lambda t: t[0], opt_state)
+                rng = rng[0]
 
-                def window(carry, batch):
+                def window(carry, g):
                     center, local, opt_state, rng = carry
-                    xw, yw = batch
+                    e, wi = g // wpe, g % wpe
+                    # the epoch's rng stream starts at its first window
+                    # and is CARRIED through the rest (and across chunk
+                    # boundaries via the checkpointed rng), so a
+                    # mid-epoch resume replays the identical stream
+                    fresh = tree_pvary(jax.random.fold_in(
+                        jax.random.fold_in(key, e), widx))
+                    rng = jnp.where(wi == 0, fresh, rng)
+                    xw = jax.lax.dynamic_index_in_dim(
+                        xs, wi, 0, keepdims=False)
+                    yw = jax.lax.dynamic_index_in_dim(
+                        ys, wi, 0, keepdims=False)
                     (local, opt_state, rng), losses = jax.lax.scan(
                         step, (local, opt_state, rng), (xw, yw))
                     new_center, new_local = merge(center, local)
@@ -152,72 +214,90 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     local = tree_pvary(local)
                     return (center, local, opt_state, rng), losses
 
-                def epoch(carry, e):
-                    center, local, opt_state = carry
-                    rng = tree_pvary(jax.random.fold_in(
-                        jax.random.fold_in(key, e), widx))
-                    (center, local, opt_state, _), losses = jax.lax.scan(
-                        window, (center, local, opt_state, rng), (xs, ys))
-                    return (center, local, opt_state), losses
-
-                (center, local, opt_state), losses = jax.lax.scan(
-                    epoch, (center, local, opt_state),
-                    jnp.arange(E) + epoch0)
+                (center, local, opt_state, rng), losses = jax.lax.scan(
+                    window, (center, local, opt_state, rng),
+                    jnp.arange(K) + g0)
                 stack = lambda t: t[None]  # noqa: E731
                 return (center, jax.tree.map(stack, local),
-                        jax.tree.map(stack, opt_state), losses[None])
+                        jax.tree.map(stack, opt_state), rng[None],
+                        losses[None])  # losses: (1, K, W)
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
                 in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
-                          P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(), P()),
                 out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
-                           P(WORKER_AXIS)),
+                           P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
         # initial carry (stacked per worker on the leading axis)
         center = model.params
         local = self._stack_workers(center)
         opt_state = self._stack_workers(opt_init(center))
+        rng = self._stack_workers(jnp.zeros((2,), jnp.uint32))
         template = {"center": center, "local": local,
-                    "opt_state": opt_state}
-        start_epoch, restored = self._maybe_resume(template)
+                    "opt_state": opt_state, "rng": rng}
+        start_w, restored = self._maybe_resume(template)
         if restored is not None:
             center = restored["center"]
             local = restored["local"]
             opt_state = restored["opt_state"]
+            rng = restored["rng"]
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
         drain(xs, ys)  # data distribution completes OUTSIDE the clock
         key = jax.random.PRNGKey(self.seed)
-        samples_per_epoch = self.num_workers * windows * W * self.batch_size
+        samples_per_window = self.num_workers * W * self.batch_size
 
         self.record_training_start()
         all_losses = []
-        epochs_done = start_epoch
-        for E in self._chunk_plan(start_epoch):
-            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
+        windows_done = start_w
+        # metrics/callbacks fire at EPOCH boundaries only (integer epoch
+        # numbers, like every other trainer); chunks ending mid-epoch
+        # accumulate into the next boundary's emit
+        acc_losses, acc_dt, acc_samples = [], 0.0, 0
+        for K in self._window_chunk_plan(start_w, total_w, wpe):
+            fn = self._compiled(lambda: build_chunk(K),
+                                extra_key=(K, wpe))
             t0 = _time.time()
-            center, local, opt_state, losses = fn(
-                center, local, opt_state, xs, ys, key,
-                jnp.int32(epochs_done))
+            center, local, opt_state, rng, losses = fn(
+                center, local, opt_state, rng, xs, ys, key,
+                jnp.int32(windows_done))
             drain(center)  # block_until_ready lies through the tunnel
             dt = _time.time() - t0
-            epochs_done += E
-            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, windows, W)
+            windows_done += K
+            losses = np.asarray(comm.fetch_global(losses))  # (workers,K,W)
             all_losses.append(losses)
-            self._emit_epoch_end(epochs_done, losses, dt,
-                                 samples_per_epoch * E)
-            self._maybe_checkpoint(
-                epochs_done,
+            # save BEFORE user callbacks run: a callback that dies (the
+            # preemption-simulation pattern) must not lose the chunk
+            self._maybe_checkpoint_windows(
+                windows_done, total_w,
                 lambda: {"center": center, "local": local,
-                         "opt_state": opt_state})
+                         "opt_state": opt_state, "rng": rng})
+            acc_losses.append(losses)
+            acc_dt += dt
+            acc_samples += samples_per_window * K
+            if windows_done % wpe == 0:
+                self._emit_epoch_end(windows_done // wpe,
+                                     np.concatenate(acc_losses, axis=1),
+                                     acc_dt, acc_samples)
+                acc_losses, acc_dt, acc_samples = [], 0.0, 0
         self.record_training_end()
 
-        history = (np.concatenate(all_losses, axis=1).tolist()
-                   if all_losses else [])
-        # history: (workers, epochs, windows, W)
+        if all_losses:
+            flat = np.concatenate(all_losses, axis=1)  # (workers, tw, W)
+            # (workers, epochs, windows, W) for runs that executed whole
+            # epochs — the standard case, and the round-2 get_history
+            # contract.  A run RESUMED mid-epoch executed a partial first
+            # epoch, so its own history stays (workers, windows, W); see
+            # Trainer.get_history.
+            if flat.shape[1] % wpe == 0:
+                flat = flat.reshape(flat.shape[0], -1, wpe, W)
+            history = flat.tolist()
+        else:
+            history = []
         return self._finalize(center, history)
 
 

@@ -24,6 +24,19 @@ import numpy as np
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--fast", action="store_true", default=False,
+        help="CI-sized accuracy gates: ~2k rows, few epochs, threshold "
+             "~0.8 — finishes on one CPU core in minutes (the full gates "
+             "are sized for a TPU run)")
+
+
+@pytest.fixture(scope="session")
+def fast_gates(request):
+    return bool(request.config.getoption("--fast"))
+
+
 @pytest.fixture(scope="session")
 def blobs_dataset():
     """Tiny 2-class gaussian-blob classification set, one-hot labels."""

@@ -77,6 +77,43 @@ def test_preemption_resume_matches_uninterrupted(tmp_path, name, extra):
     assert len(t2.metrics) < len(t3.metrics) or t2.metrics[0]["epoch"] > 1
 
 
+def test_window_granular_mid_epoch_resume(tmp_path):
+    """checkpoint_every_windows chunks INSIDE an epoch: die right after a
+    checkpoint that lands mid-epoch, resume, and the final weights must
+    be bit-equal to the uninterrupted run (VERDICT r2 #7)."""
+    import dist_keras_tpu as dk
+
+    ds = _digits_subset()  # 512 rows; 4 workers x batch 16 = 8 steps/w
+    kw = dict(loss="categorical_crossentropy", worker_optimizer="adam",
+              batch_size=16, num_workers=4, communication_window=2,
+              label_col="label_encoded", seed=3)
+    # 4 windows/epoch; cadence 3 windows -> first save at window 3,
+    # genuinely mid-epoch (epoch 0, window 3 of 4)
+    ckdir = str(tmp_path / "ck")
+
+    class Die(RuntimeError):
+        pass
+
+    def poison(trainer, epoch, logs):
+        raise Die  # preemption right after the first chunk's save
+
+    t1 = dk.ADAG(_model(), num_epoch=4, checkpoint_dir=ckdir,
+                 checkpoint_every_windows=3, callbacks=[poison], **kw)
+    with pytest.raises(Die):
+        t1.train(ds)
+    assert t1._checkpointer.all_steps() == [3]  # only the mid-epoch save
+
+    # fresh trainer resumes from window 3 and finishes the 4 epochs
+    t2 = dk.ADAG(_model(), num_epoch=4, checkpoint_dir=ckdir,
+                 checkpoint_every_windows=3, resume=True, **kw)
+    resumed = t2.train(ds)
+
+    t3 = dk.ADAG(_model(), num_epoch=4, **kw)
+    control = t3.train(ds)
+    for wa, wb in zip(resumed.get_weights(), control.get_weights()):
+        np.testing.assert_array_equal(wa, wb)  # bit-equal
+
+
 def test_callbacks_fire_every_epoch():
     import dist_keras_tpu as dk
 
