@@ -114,7 +114,11 @@ def _run_trainer_config(name, make_trainer, ds, batch, flops_per_sample,
                         peak, baseline, runs=5):
     import jax
 
-    make_trainer().train(ds)  # compile warm-up (shared jit cache)
+    # two warm-up runs (shared jit cache): the first compiles, the
+    # second warms device-side caches — without it the first TIMED run
+    # reads ~20% slow on some configs and pollutes the spread
+    make_trainer().train(ds)
+    make_trainer().train(ds)
     sps_runs = []
     for _ in range(runs):
         t = make_trainer()
@@ -273,7 +277,9 @@ def bench_single_mnist_mlp(peak):
     from dist_keras_tpu.trainers import SingleTrainer
     from dist_keras_tpu.utils.misc import one_hot
 
-    batch, steps, epochs = 512, 120, 64
+    # 192 epochs: the MLP runs ~4.7M samples/s, so a short window would
+    # be dominated by dispatch jitter (spread ~30% at 64 epochs)
+    batch, steps, epochs = 512, 120, 192
     rng = np.random.default_rng(0)
     n = batch * steps
     y = rng.integers(0, 10, n)
