@@ -384,7 +384,7 @@ def _from_bh(x, b, h):
 
 
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=512, block_k=1024, q_offset=0,
+                             block_q=512, block_k=2048, q_offset=0,
                              kv_offset=0, interpret=False):
     """q,k,v: (B, T, H, D) -> (out (B,T,H,D), lse (B,H,T) float32).
 
@@ -406,7 +406,7 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=1024, interpret=False):
+                    block_k=2048, interpret=False):
     """Pallas attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                       block_q=block_q, block_k=block_k,
@@ -415,7 +415,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
 
 
 def attention_auto(q, k, v, causal=False, scale=None, block_q=512,
-                   block_k=1024):
+                   block_k=2048):
     """Backend-dispatching attention: Pallas kernel on TPU, jnp reference
     elsewhere.  Decided at trace time via ``jax.default_backend()`` so it
     works under jit/shard_map (tracers carry no device info)."""
