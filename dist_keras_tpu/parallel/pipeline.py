@@ -100,8 +100,7 @@ def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,
         y = stage_fn(stage_params, inp)
         # activations hop to the next stage; the last stage's output
         # leaves the pipe here instead
-        buf_next = jax.tree.map(lambda l: lax.ppermute(l, axis, perm_fwd),
-                                y)
+        buf_next = tree_ppermute(y, perm_fwd, axis)
         c = collect(y)
         mi = t - (p - 1)  # microbatch finishing at the last stage
         take = jnp.logical_and(idx == p - 1, mi >= 0)
@@ -115,7 +114,10 @@ def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,
         outs = jax.tree.map(put, outs, c)
         return (buf_next, outs), None
 
-    from dist_keras_tpu.parallel.collectives import tree_pvary
+    from dist_keras_tpu.parallel.collectives import (
+        tree_ppermute,
+        tree_pvary,
+    )
 
     feed0 = jax.tree.map(lambda a: a[0], xs)
     buf0 = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype), feed0)
@@ -418,6 +420,8 @@ def pp_transformer_1f1b_grads(params, stacked_blocks, x, y, cfg,
     cf = cfg.get("moe_capacity_factor", 1.25)
     m = num_microbatches
     b, t = x.shape[0], x.shape[1]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
     mb = b // m
     xs_r = x.reshape(m, mb, t, x.shape[2])
     ys_r = y.reshape(m, mb)
