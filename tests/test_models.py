@@ -64,6 +64,27 @@ def test_dropout_train_vs_eval():
     assert not np.allclose(eval_out, train_out)
 
 
+def test_dropout_keep_rate_and_scaling():
+    """Both mask paths (the exact-8-bit threshold fast path for
+    0.25/0.5/0.75 and the bernoulli fallback for other rates): empirical
+    keep fraction matches, survivors are scaled by 1/keep, rng is
+    deterministic."""
+    import jax.numpy as jnp
+
+    x = jnp.ones((512, 512), jnp.float32)
+    for rate in (0.25, 0.5, 0.13):  # 0.13 exercises the fallback
+        d = Dropout(rate)
+        key = jax.random.PRNGKey(42)
+        y = np.asarray(d.apply({}, x, training=True, rng=key))
+        keep = 1.0 - rate
+        frac = (y != 0).mean()
+        assert abs(frac - keep) < 0.01, (rate, frac)
+        np.testing.assert_allclose(np.unique(y[y != 0]), [1.0 / keep],
+                                   rtol=1e-6)
+        y2 = np.asarray(d.apply({}, x, training=True, rng=key))
+        np.testing.assert_array_equal(y, y2)  # same key -> same mask
+
+
 def test_layernorm_and_batchnorm():
     m = Sequential([Dense(16), LayerNorm(), BatchNorm()])
     m.build((8,))
