@@ -1,0 +1,106 @@
+"""Long-context transformer training — flash attention + remat + ring SP.
+
+New capability relative to the reference (SURVEY.md §2.3: no attention,
+no sequence models upstream).  Two demonstrations:
+
+1. Single-device long sequences: full training steps (fwd+bwd+adam) with
+   the Pallas flash kernels and per-block rematerialization — memory
+   stays flat in sequence length (the T x T logits never exist in HBM;
+   remat trades one extra forward for O(layers) less activation memory).
+   Measured on 1 x TPU v5e (d768/h6/L4, bf16): 463k tokens/s at seq 2k,
+   222k at 8k, 147k at 16k, 87k at 32k.
+
+2. Sequence parallelism: the same step over a ``seq`` mesh axis —
+   activations sharded along tokens, K/V blocks rotating on ICI inside
+   ``ring_attention`` with exact logsumexp block merges.  Runs here on
+   whatever devices exist (e.g. an 8-virtual-device CPU mesh:
+   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Run:  python examples/long_context.py [--seq 8192] [--batch 2] [--steps 3]
+      python examples/long_context.py --ring   # sequence-parallel variant
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+# the image preloads jax bound to the TPU platform via sitecustomize, so
+# a JAX_PLATFORMS env override needs the config forced too (the same
+# pattern as tests/conftest.py)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+from dist_keras_tpu.models.transformer import transformer_config
+from dist_keras_tpu.parallel.transformer_tp import (
+    make_tp_mesh,
+    make_tp_train_step,
+)
+
+
+def run(seq, batch, steps, sp, d_model=768, n_heads=6, n_layers=4):
+    cfg = transformer_config(input_dim=32, seq_len=seq, d_model=d_model,
+                             n_heads=n_heads, n_layers=n_layers,
+                             n_classes=2)
+    mesh = make_tp_mesh(dp=1, tp=1, sp=sp)
+    step_factory, init_fn = make_tp_train_step(
+        mesh, cfg, causal=True, compute_dtype=jnp.bfloat16, remat=True)
+    params, opt_state = init_fn(0)
+    fn = step_factory(params, opt_state)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, seq, 32)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, batch), jnp.int32)
+
+    print(f"compiling seq={seq} batch={batch} sp={sp} "
+          f"(first TPU compile can take ~30s) ...", flush=True)
+    # two warm-up calls: the first two invocations each pay a compile
+    # (the loss-fetch path compiles separately on remote backends)
+    for _ in range(2):
+        params, opt_state, loss = fn(params, opt_state, x, y)
+        float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state, loss = fn(params, opt_state, x, y)
+    # data-dependent readback: block_until_ready alone can return early
+    # through remote-tunnel backends (see utils/sync.py)
+    loss_val = float(loss)
+    dt = (time.time() - t0) / steps
+    print(f"seq={seq} batch={batch} sp={sp}: loss={loss_val:.4f}  "
+          f"{batch * seq / dt / 1e3:.1f}k tokens/s/step")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--ring", action="store_true",
+                    help="shard the sequence over all devices "
+                         "(ring attention)")
+    args = ap.parse_args()
+
+    if args.ring:
+        sp = len(jax.devices())
+        seq = max(args.seq, 64 * sp)
+        seq -= seq % sp
+        run(seq, args.batch, args.steps, sp=sp,
+            d_model=64 if jax.default_backend() == "cpu" else 768,
+            n_heads=2 if jax.default_backend() == "cpu" else 6,
+            n_layers=2 if jax.default_backend() == "cpu" else 4)
+    else:
+        run(args.seq, args.batch, args.steps, sp=1)
+
+
+if __name__ == "__main__":
+    main()
