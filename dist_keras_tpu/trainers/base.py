@@ -307,14 +307,28 @@ class DistributedTrainer(Trainer):
 
         if not comm.is_multi_host():
             return jnp.asarray(x)
+        return self._put_worker_chunk(x)[0]
+
+    def _put_worker_chunk(self, *arrays):
+        """Async device_put of host ``(local_workers, ...)`` arrays with
+        the worker sharding — the streaming feed's transfer primitive
+        (``data/feed.py``).  Unlike ``_to_device`` the sharding is always
+        explicit, so each chunk's H2D goes straight to its worker's
+        device and can overlap the running dispatch."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from dist_keras_tpu.comm import backend as comm
         from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 
-        x = np.asarray(x)
-        return jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P(WORKER_AXIS)), x,
-            (self.num_workers,) + x.shape[1:])
+        sharding = NamedSharding(self.mesh, P(WORKER_AXIS))
+        if not comm.is_multi_host():
+            return tuple(jax.device_put(a, sharding) for a in arrays)
+        out = []
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            out.append(jax.make_array_from_process_local_data(
+                sharding, a, (self.num_workers,) + a.shape[1:]))
+        return tuple(out)
 
     def _stack_workers(self, tree, inner=()):
         """Replicate a pytree with a leading (num_workers, *inner) axis —

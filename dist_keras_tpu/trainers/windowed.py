@@ -67,7 +67,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     """
 
     def __init__(self, keras_model, num_workers=2, communication_window=5,
-                 parallelism_factor=1, checkpoint_every_windows=None, **kw):
+                 parallelism_factor=1, checkpoint_every_windows=None,
+                 stream_chunk_windows=None, max_resident_bytes=None, **kw):
         super().__init__(keras_model, num_workers=num_workers, **kw)
         self.communication_window = int(communication_window)
         self.parallelism_factor = int(parallelism_factor)
@@ -81,6 +82,26 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         if self.checkpoint_every_windows and not self.checkpoint_dir:
             raise ValueError(
                 "checkpoint_every_windows requires checkpoint_dir")
+        # ---- streaming input pipeline (the reference's partition-iterator
+        # property, workers.py:~60: an epoch never has to fit on-device).
+        # stream_chunk_windows=C streams the data C windows per dispatch
+        # through a double-buffered ChunkFeed (<= 2 chunks ever resident);
+        # max_resident_bytes=B auto-enables streaming whenever the epoch
+        # tensor would exceed B bytes of device memory, sizing C so two
+        # in-flight chunks fit inside B.  Default (both None) keeps the
+        # round-1 whole-run-resident fast path.
+        self.stream_chunk_windows = (int(stream_chunk_windows)
+                                     if stream_chunk_windows else None)
+        if self.stream_chunk_windows is not None \
+                and self.stream_chunk_windows < 1:
+            raise ValueError(
+                f"stream_chunk_windows={stream_chunk_windows} must be >= 1")
+        self.max_resident_bytes = (int(max_resident_bytes)
+                                   if max_resident_bytes else None)
+        if self.max_resident_bytes is not None and self.max_resident_bytes < 1:
+            raise ValueError(
+                f"max_resident_bytes={max_resident_bytes} must be >= 1")
+        self._streamed = False  # set by train(); introspectable by tests
 
     def _cache_extras(self):
         # the per-chunk epoch count is appended via _compiled(extra_key=)
@@ -95,12 +116,19 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         the worker axis bound."""
         raise NotImplementedError
 
-    def _window_chunk_plan(self, start_w, total_w, wpe):
+    def _window_chunk_plan(self, start_w, total_w, wpe, data_chunk=None):
         """Chunk sizes in WINDOW units: the dispatch breaks at the union
         of epoch boundaries (when callbacks need on_epoch_end at real
         epoch ends) and checkpoint-cadence boundaries (counted from the
         resume point, possibly mid-epoch).  No hooks = one dispatch (the
-        round-1 perf path)."""
+        round-1 perf path).
+
+        ``data_chunk=C`` (streaming mode) additionally cuts at every
+        epoch boundary and every C-th window *within* each epoch
+        (aligned to the epoch start, NOT the resume point, so a resumed
+        run reuses the identical chunk grid): each dispatch's data is
+        then one contiguous epoch-relative slice of <= C windows, the
+        unit the ChunkFeed transfers."""
         remaining = total_w - start_w
         if remaining <= 0:
             return []
@@ -111,6 +139,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         cadence = self._ckpt_cadence_windows(wpe)
         if cadence:
             bounds |= set(range(start_w + cadence, total_w, cadence))
+        if data_chunk:
+            # k=0 of the grid below lands on every epoch boundary too
+            first_epoch = start_w // wpe
+            for e in range(first_epoch, -(-total_w // wpe)):
+                bounds |= {e * wpe + k for k in range(0, wpe, data_chunk)
+                           if start_w < e * wpe + k}
         cuts = sorted(b for b in bounds if start_w < b <= total_w)
         out, prev = [], start_w
         for b in cuts:
@@ -128,15 +162,20 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             return self.checkpoint_every * wpe
         return None
 
-    def _maybe_checkpoint_windows(self, windows_done, total_w, state_fn):
-        ckptr = self._checkpointer_or_none()
-        if ckptr is None:
-            return
+    def _ckpt_due_windows(self, windows_done, total_w):
+        """True when a save is owed at this window count — the dispatch
+        loop's sync-boundary predicate (a due save forces the pipeline
+        flush that makes the state fetchable)."""
+        if self._checkpointer_or_none() is None:
+            return False
         last = getattr(self, "_last_ckpt_epoch", 0)  # in window units here
         cadence = (self._ckpt_cadence_windows(self._wpe)
                    or self.num_epoch * self._wpe)
-        if windows_done - last >= cadence or windows_done >= total_w:
-            ckptr.save(windows_done, state_fn())
+        return windows_done - last >= cadence or windows_done >= total_w
+
+    def _maybe_checkpoint_windows(self, windows_done, total_w, state_fn):
+        if self._ckpt_due_windows(windows_done, total_w):
+            self._checkpointer_or_none().save(windows_done, state_fn())
             self._last_ckpt_epoch = windows_done
 
     # --- shared training loop ------------------------------------------
@@ -186,16 +225,23 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
 
-        def build_chunk(K):
+        def build_chunk(K, streamed=False):
+            """K-window dispatch.  Resident mode: the whole (wpe, W, ...)
+            epoch tensor is an argument and windows are selected by
+            dynamic index modulo wpe (data reused across epochs inside
+            one dispatch).  Streaming mode: ONLY the chunk's (K, W, ...)
+            slice arrives and the scan consumes it directly — identical
+            window algebra, so the two paths are bit-equal on the same
+            data (asserted in tests/test_streaming_feed.py)."""
             def body(center, local, opt_state, rng, xs, ys, key, g0):
-                xs, ys = xs[0], ys[0]  # (wpe, W, batch, ...)
+                xs, ys = xs[0], ys[0]  # (wpe | K, W, batch, ...)
                 widx = jax.lax.axis_index(WORKER_AXIS)
                 # carry state arrives stacked (1, ...) per worker shard
                 local = jax.tree.map(lambda t: t[0], local)
                 opt_state = jax.tree.map(lambda t: t[0], opt_state)
                 rng = rng[0]
 
-                def window(carry, g):
+                def window(carry, g, xw, yw):
                     center, local, opt_state, rng = carry
                     e, wi = g // wpe, g % wpe
                     # the epoch's rng stream starts at its first window
@@ -205,10 +251,6 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     fresh = tree_pvary(jax.random.fold_in(
                         jax.random.fold_in(key, e), widx))
                     rng = jnp.where(wi == 0, fresh, rng)
-                    xw = jax.lax.dynamic_index_in_dim(
-                        xs, wi, 0, keepdims=False)
-                    yw = jax.lax.dynamic_index_in_dim(
-                        ys, wi, 0, keepdims=False)
                     (local, opt_state, rng), losses = jax.lax.scan(
                         step, (local, opt_state, rng), (xw, yw))
                     new_center, new_local = merge(center, local)
@@ -221,9 +263,23 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     local = tree_pvary(local)
                     return (center, local, opt_state, rng), losses
 
-                (center, local, opt_state, rng), losses = jax.lax.scan(
-                    window, (center, local, opt_state, rng),
-                    jnp.arange(K) + g0)
+                carry = (center, local, opt_state, rng)
+                if streamed:
+                    carry, losses = jax.lax.scan(
+                        lambda c, inp: window(c, *inp), carry,
+                        (jnp.arange(K) + g0, xs, ys))
+                else:
+                    def indexed(c, g):
+                        wi = g % wpe
+                        xw = jax.lax.dynamic_index_in_dim(
+                            xs, wi, 0, keepdims=False)
+                        yw = jax.lax.dynamic_index_in_dim(
+                            ys, wi, 0, keepdims=False)
+                        return window(c, g, xw, yw)
+
+                    carry, losses = jax.lax.scan(
+                        indexed, carry, jnp.arange(K) + g0)
+                center, local, opt_state, rng = carry
                 stack = lambda t: t[None]  # noqa: E731
                 return (center, jax.tree.map(stack, local),
                         jax.tree.map(stack, opt_state), rng[None],
@@ -258,11 +314,44 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             opt_state = restored["opt_state"]
             rng = restored["rng"]
 
-        xs = self._to_device(xs)
-        ys = self._to_device(ys)
-        # data AND carry-state distribution completes OUTSIDE the
-        # clock (the stacked local/opt_state device_puts are async too)
-        drain(xs, ys, center, local, opt_state, rng)
+        # ---- streaming decision: per-DEVICE residency is the HBM
+        # constraint (each device holds its own worker's epoch shard)
+        stream_C = self.stream_chunk_windows
+        per_device_epoch_bytes = (xs.nbytes + ys.nbytes) // max(
+            1, xs.shape[0])
+        if (stream_C is None and self.max_resident_bytes
+                and per_device_epoch_bytes > self.max_resident_bytes):
+            per_window = max(1, per_device_epoch_bytes // wpe)
+            # two chunks in flight (executing + prefetched) must fit
+            stream_C = max(1, self.max_resident_bytes // (2 * per_window))
+        if stream_C:
+            stream_C = max(1, min(int(stream_C), wpe))
+        self._streamed = bool(stream_C)
+
+        plan = self._window_chunk_plan(start_w, total_w, wpe,
+                                       data_chunk=stream_C)
+        if stream_C:
+            from dist_keras_tpu.data.feed import ChunkFeed
+
+            w, spans = start_w, []
+            for K in plan:
+                spans.append((w % wpe, K))  # epoch-relative slice
+                w += K
+            feed = ChunkFeed(spans, self._put_worker_chunk, xs, ys)
+            self._last_feed = feed  # test introspection
+            # chunk 0's transfer and the carry state land OUTSIDE the
+            # clock, like the resident path's one-shot H2D; chunks 1..
+            # transfer inside it, overlapped under the running dispatch
+            # (plan may be empty: resume of an already-finished run)
+            first = feed.get(0) if plan else ()
+            drain(center, local, opt_state, rng, *first)
+        else:
+            xs = self._to_device(xs)
+            ys = self._to_device(ys)
+            # data AND carry-state distribution completes OUTSIDE the
+            # clock (the stacked local/opt_state device_puts are async
+            # too)
+            drain(xs, ys, center, local, opt_state, rng)
         key = jax.random.PRNGKey(self.seed)
         samples_per_window = self.num_workers * W * self.batch_size
 
@@ -273,32 +362,79 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         # numbers, like every other trainer); chunks ending mid-epoch
         # accumulate into the next boundary's emit
         acc_losses, acc_dt, acc_samples = [], 0.0, 0
-        for K in self._window_chunk_plan(start_w, total_w, wpe):
-            fn = self._compiled(lambda: build_chunk(K),
-                                extra_key=(K, wpe))
-            t0 = _time.time()
-            center, local, opt_state, rng, losses = fn(
-                center, local, opt_state, rng, xs, ys, key,
-                jnp.int32(windows_done))
-            drain(center)  # block_until_ready lies through the tunnel
-            dt = _time.time() - t0
-            windows_done += K
-            losses = np.asarray(comm.fetch_global(losses))  # (workers,K,W)
-            all_losses.append(losses)
-            # save BEFORE user callbacks run: a callback that dies (the
-            # preemption-simulation pattern) must not lose the chunk
-            self._maybe_checkpoint_windows(
-                windows_done, total_w,
-                lambda: {"center": center, "local": local,
-                         "opt_state": opt_state, "rng": rng})
-            acc_losses.append(losses)
-            acc_dt += dt
-            acc_samples += samples_per_window * K
-            if windows_done % wpe == 0:
-                self._emit_epoch_end(windows_done // wpe,
-                                     np.concatenate(acc_losses, axis=1),
-                                     acc_dt, acc_samples)
-                acc_losses, acc_dt, acc_samples = [], 0.0, 0
+        # Streamed chunks PIPELINE: losses of chunk i are fetched only
+        # when (a) a second chunk is already in flight (depth-2 bound so
+        # the feed's two-buffer residency guarantee holds) or (b) a sync
+        # boundary (epoch end / checkpoint due / final chunk) arrives.
+        # Non-boundary chunks thus cost no tunnel round trip — the sync
+        # cadence is per-epoch, not per-chunk.  Resident-mode chunks end
+        # only at boundaries, so its behavior is exactly the round-3 loop.
+        pending = []  # [(chunk_idx, device losses)]
+
+        def _retire_one():
+            j, lj = pending.pop(0)
+            arr = np.asarray(comm.fetch_global(lj))  # blocks until j done
+            if stream_C:
+                feed.release(j)
+            all_losses.append(arr)
+            acc_losses.append(arr)
+
+        t_mark = _time.time()
+        try:
+            for i, K in enumerate(plan):
+                if stream_C:
+                    fn = self._compiled(
+                        lambda: build_chunk(K, streamed=True),
+                        extra_key=("stream", K, wpe))
+                    data = feed.get(i)
+                else:
+                    fn = self._compiled(lambda: build_chunk(K),
+                                        extra_key=(K, wpe))
+                    data = (xs, ys)
+                center, local, opt_state, rng, losses = fn(
+                    center, local, opt_state, rng, *data, key,
+                    jnp.int32(windows_done))
+                pending.append((i, losses))
+                windows_done += K
+                if stream_C:
+                    # retire the previous chunk BEFORE prefetching the
+                    # next: at most two chunks' data is ever
+                    # device-resident, and the i+1 transfer still
+                    # overlaps chunk i's execution
+                    while len(pending) > 1:
+                        _retire_one()
+                    feed.prefetch(i + 1)
+                boundary = (windows_done % wpe == 0
+                            or i == len(plan) - 1
+                            or self._ckpt_due_windows(windows_done,
+                                                      total_w))
+                acc_samples += samples_per_window * K
+                if not boundary:
+                    continue
+                drain(center)  # block_until_ready lies via the tunnel
+                acc_dt += _time.time() - t_mark
+                # host-side work below (loss fetches, checkpoint I/O,
+                # user callbacks) stays OUTSIDE the clock, as round 3
+                while pending:
+                    _retire_one()
+                # save BEFORE user callbacks run: a callback that dies
+                # (preemption simulation) must not lose the chunk
+                self._maybe_checkpoint_windows(
+                    windows_done, total_w,
+                    lambda: {"center": center, "local": local,
+                             "opt_state": opt_state, "rng": rng})
+                if windows_done % wpe == 0:
+                    self._emit_epoch_end(windows_done // wpe,
+                                         np.concatenate(acc_losses,
+                                                        axis=1),
+                                         acc_dt, acc_samples)
+                    acc_losses, acc_dt, acc_samples = [], 0.0, 0
+                t_mark = _time.time()
+        finally:
+            # exception-safe (a raising user callback must not leave the
+            # feed pinning the host epoch tensors for the trainer's life)
+            if stream_C:
+                feed.close()  # keeps stats, frees data references
         self.record_training_end()
 
         if all_losses:
