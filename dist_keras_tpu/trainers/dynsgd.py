@@ -15,10 +15,15 @@ updates the worker missed.  The commit itself is a masked ``psum`` executed
 every step (zero contribution from non-committing workers), so the whole
 schedule stays one compiled ``lax.scan`` with no data-dependent control flow.
 
-Like the other distributed trainers, epochs loop on the host over
-device-resident data (one H2D transfer), and all per-worker state — local
-replica, pulled snapshot, optimizer state, staleness counters — persists
-across epochs.
+Round 4: the dispatch is STEP-granular through the shared ``ChunkRunner``
+(``trainers/chunking.py``), which buys DynSGD the two capabilities the
+windowed family got in rounds 3-4 — ``checkpoint_every_windows`` saves
+mid-epoch (the staggered schedule has the most state to lose on
+preemption: pulled snapshots, staleness counters, the in-epoch rng are
+all in the payload and resume bit-exactly) and
+``stream_chunk_windows``/``max_resident_bytes`` stream the data through
+the double-buffered ChunkFeed, so an epoch no longer has to fit in HBM
+(the reference's partition-iterator property, workers.py:~60).
 """
 
 from __future__ import annotations
@@ -31,10 +36,10 @@ from jax.sharding import PartitionSpec as P
 from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
-from dist_keras_tpu.trainers.base import DistributedTrainer
+from dist_keras_tpu.trainers.chunking import run_chunked
 from dist_keras_tpu.trainers.step import make_model_step
+from dist_keras_tpu.trainers.windowed import AsynchronousDistributedTrainer
 from dist_keras_tpu.utils.pytree import tree_merge_floats, tree_zeros_like
-from dist_keras_tpu.utils.sync import drain
 
 try:
     from jax import shard_map
@@ -42,14 +47,20 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _make_body(step, window, num_workers, num_epochs_chunk):
-    """Chunked scan body: runs ``num_epochs_chunk`` epochs from absolute
-    epoch ``epoch0`` with ALL per-worker state (pulled snapshot, local
-    replica, optimizer state, staleness counters) carried in/out, so the
-    staggered-staleness schedule survives checkpoint/resume boundaries."""
+def _make_body(step, window, num_workers, steps_per_epoch, T, streamed):
+    """Chunked scan body over a flat range of GLOBAL steps [t0, t0+T).
+
+    All per-worker state (pulled snapshot, local replica, optimizer
+    state, staleness counters, in-epoch rng) is carried in/out, so the
+    staggered-staleness schedule survives chunk boundaries at ANY step —
+    including mid-epoch checkpoint cuts and streaming data-chunk cuts.
+    The epoch's rng stream starts at its first step (``t % spe == 0``)
+    and is carried through the rest, so a mid-epoch resume replays the
+    identical stream (same construction as windowed.build_chunk).
+    """
     def body(center, pulled, local, opt_state, last_seen, global_count,
-             xs, ys, key, epoch0):
-        xs, ys = xs[0], ys[0]
+             rng, xs, ys, key, t0):
+        xs, ys = xs[0], ys[0]  # (spe | T, batch, ...)
         widx = jax.lax.axis_index(WORKER_AXIS)
         phase = (widx * window) // num_workers  # staggered commit schedule
 
@@ -59,11 +70,16 @@ def _make_body(step, window, num_workers, num_epochs_chunk):
         local = jax.tree.map(unstack, local)
         opt_state = jax.tree.map(unstack, opt_state)
         last_seen = unstack(last_seen)
+        rng = rng[0]
 
         def one_step(carry, inp):
             (center, pulled, local, opt_state, rng,
              last_seen, global_count) = carry
             t, x, y = inp
+            e, si = t // steps_per_epoch, t % steps_per_epoch
+            fresh = tree_pvary(jax.random.fold_in(
+                jax.random.fold_in(key, e), widx))
+            rng = jnp.where(si == 0, fresh, rng)
             (local, opt_state, rng), loss = step(
                 (local, opt_state, rng), (x, y))
 
@@ -96,120 +112,126 @@ def _make_body(step, window, num_workers, num_epochs_chunk):
             return (center, pulled, local, opt_state, rng,
                     last_seen, global_count), loss
 
-        steps = xs.shape[0]
+        carry = (center, pulled, local, opt_state, rng,
+                 last_seen, global_count)
+        if streamed:
+            carry, losses = jax.lax.scan(
+                one_step, carry, (jnp.arange(T) + t0, xs, ys))
+        else:
+            def indexed(c, t):
+                si = t % steps_per_epoch
+                x = jax.lax.dynamic_index_in_dim(xs, si, 0, keepdims=False)
+                y = jax.lax.dynamic_index_in_dim(ys, si, 0, keepdims=False)
+                return one_step(c, (t, x, y))
 
-        def epoch(carry, e):
-            (center, pulled, local, opt_state,
-             last_seen, global_count) = carry
-            rng = tree_pvary(jax.random.fold_in(
-                jax.random.fold_in(key, e), widx))
-            ts = jnp.arange(steps) + e * steps
-            state = (center, pulled, local, opt_state, rng,
-                     last_seen, global_count)
-            state, losses = jax.lax.scan(one_step, state, (ts, xs, ys))
-            (center, pulled, local, opt_state, _,
-             last_seen, global_count) = state
-            return (center, pulled, local, opt_state,
-                    last_seen, global_count), losses
-
-        carry = (center, pulled, local, opt_state, last_seen, global_count)
-        carry, losses = jax.lax.scan(
-            epoch, carry, jnp.arange(num_epochs_chunk) + epoch0)
-        (center, pulled, local, opt_state, last_seen, global_count) = carry
+            carry, losses = jax.lax.scan(
+                indexed, carry, jnp.arange(T) + t0)
+        (center, pulled, local, opt_state, rng,
+         last_seen, global_count) = carry
         stack = lambda t: t[None]  # noqa: E731
         return (center, jax.tree.map(stack, pulled),
                 jax.tree.map(stack, local), jax.tree.map(stack, opt_state),
-                stack(last_seen), global_count,
-                losses[None])  # losses: (1, epochs, steps)
+                stack(last_seen), global_count, rng[None],
+                losses[None])  # losses: (1, T)
 
     return body
 
 
-class DynSGD(DistributedTrainer):
-    def __init__(self, keras_model, num_workers=2, communication_window=5,
-                 **kw):
-        super().__init__(keras_model, num_workers=num_workers, **kw)
-        self.communication_window = int(communication_window)
+class DynSGD(AsynchronousDistributedTrainer):
+    """trainers.py:~700 / workers.py:~530; inherits the windowed family's
+    checkpoint/streaming kwargs (cadences are counted in communication
+    windows = ``communication_window`` steps)."""
 
-    def _cache_extras(self):
-        # the per-chunk epoch count is appended via _compiled(extra_key=)
-        return super()._cache_extras() + (self.communication_window,)
+    def merge(self, center, local):  # pragma: no cover - not windowed
+        raise NotImplementedError(
+            "DynSGD commits per-step with staggered phases; it does not "
+            "use the windowed merge hook")
 
     def train(self, dataset, shuffle=False):
-        import time as _time
-
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
+        spe = xs.shape[1]  # steps per epoch
+        total_t = self.num_epoch * spe
+        W = self.communication_window
         mesh = self.mesh
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
+        key = jax.random.PRNGKey(self.seed)
 
-        def build_chunk(E):
+        def build_chunk(T, streamed=False):
+            body = _make_body(step, W, self.num_workers, spe, T, streamed)
             return jax.jit(shard_map(
-                _make_body(step, self.communication_window,
-                           self.num_workers, E),
-                mesh=mesh,
+                body, mesh=mesh,
                 in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
                           P(WORKER_AXIS), P(WORKER_AXIS), P(),
-                          P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(), P()),
                 out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
                            P(WORKER_AXIS), P(WORKER_AXIS), P(),
-                           P(WORKER_AXIS)),
+                           P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
         center = model.params
         pulled = self._stack_workers(center)
         local = self._stack_workers(center)
         opt_state = self._stack_workers(opt_init(center))
-        last_seen = jnp.zeros((self.num_workers,), jnp.int32)
+        last_seen = self._stack_workers(jnp.zeros((), jnp.int32))
         global_count = jnp.zeros((), jnp.int32)
+        rng = self._stack_workers(jnp.zeros((2,), jnp.uint32))
         template = {"center": center, "pulled": pulled, "local": local,
                     "opt_state": opt_state, "last_seen": last_seen,
-                    "global_count": global_count}
-        start_epoch, restored = self._maybe_resume(template)
+                    "global_count": global_count, "rng": rng}
+        start_t, restored = self._maybe_resume(template)
         if restored is not None:
+            if "rng" not in restored:
+                raise ValueError(
+                    "checkpoint predates step-granular DynSGD training "
+                    "state (no 'rng' leaf; its step counts epochs, not "
+                    "steps) — restart training or point checkpoint_dir "
+                    "at a fresh directory")
             center = restored["center"]
             pulled = restored["pulled"]
             local = restored["local"]
             opt_state = restored["opt_state"]
             last_seen = restored["last_seen"]
             global_count = restored["global_count"]
+            rng = restored["rng"]
 
-        xs = self._to_device(xs)
-        ys = self._to_device(ys)
-        # data AND carry-state distribution completes OUTSIDE the clock
-        drain(xs, ys, center, pulled, local, opt_state, last_seen)
-        key = jax.random.PRNGKey(self.seed)
-        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
-
-        self.record_training_start()
-        all_losses = []
-        epochs_done = start_epoch
-        for E in self._chunk_plan(start_epoch):
-            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
-            t0 = _time.time()
+        def dispatch(i, T, steps_done, data):
+            nonlocal center, pulled, local, opt_state, last_seen, \
+                global_count, rng
+            streamed = self._streamed
+            fn = self._compiled(
+                lambda: build_chunk(T, streamed=streamed),
+                extra_key=("stream", T, spe) if streamed else (T, spe))
             (center, pulled, local, opt_state, last_seen, global_count,
-             losses) = fn(center, pulled, local, opt_state, last_seen,
-                          global_count, xs, ys, key,
-                          jnp.int32(epochs_done))
-            drain(center)  # block_until_ready lies through the tunnel
-            dt = _time.time() - t0
-            epochs_done += E
-            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)
-            all_losses.append(losses)
-            self._emit_epoch_end(epochs_done, losses, dt,
-                                 samples_per_epoch * E)
-            self._maybe_checkpoint(
-                epochs_done,
-                lambda: {"center": center, "pulled": pulled,
-                         "local": local, "opt_state": opt_state,
-                         "last_seen": last_seen,
-                         "global_count": global_count})
-        self.record_training_end()
+             rng, losses) = fn(center, pulled, local, opt_state,
+                               last_seen, global_count, rng, *data,
+                               key, jnp.int32(steps_done))
+            return losses
 
-        history = (np.concatenate(all_losses, axis=1).tolist()
-                   if all_losses else [])
-        # history: (workers, epochs, steps)
+        # cadence kwargs stay in window units for API parity with the
+        # family; the dispatch machinery runs in STEP units.  History
+        # entries are (workers, T) per chunk; whole-epoch runs reshape
+        # to (workers, epochs, steps), mid-epoch resumes stay flat.
+        cadence = (self.checkpoint_every_windows * W
+                   if self.checkpoint_every_windows
+                   else self.checkpoint_every * spe
+                   if self.checkpoint_every else None)
+        history = run_chunked(
+            self, xs, ys, start=start_t, total=total_t, per_epoch=spe,
+            stream_units=(self.stream_chunk_windows * W
+                          if self.stream_chunk_windows else None),
+            cadence=cadence,
+            samples_per_unit=self.num_workers * self.batch_size,
+            dispatch=dispatch, sync_ref=lambda: center,
+            state_fn=lambda: {"center": center, "pulled": pulled,
+                              "local": local, "opt_state": opt_state,
+                              "last_seen": last_seen,
+                              "global_count": global_count, "rng": rng},
+            carry_leaves=(center, pulled, local, opt_state, last_seen,
+                          rng),
+            fetch_global=comm.fetch_global)
         return self._finalize(center, history)

@@ -41,6 +41,7 @@ from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
+from dist_keras_tpu.trainers.chunking import run_chunked
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import (
     tree_add,
@@ -48,7 +49,6 @@ from dist_keras_tpu.utils.pytree import (
     tree_scale,
     tree_sub,
 )
-from dist_keras_tpu.utils.sync import drain
 
 try:
     from jax import shard_map
@@ -116,42 +116,6 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         the worker axis bound."""
         raise NotImplementedError
 
-    def _window_chunk_plan(self, start_w, total_w, wpe, data_chunk=None):
-        """Chunk sizes in WINDOW units: the dispatch breaks at the union
-        of epoch boundaries (when callbacks need on_epoch_end at real
-        epoch ends) and checkpoint-cadence boundaries (counted from the
-        resume point, possibly mid-epoch).  No hooks = one dispatch (the
-        round-1 perf path).
-
-        ``data_chunk=C`` (streaming mode) additionally cuts at every
-        epoch boundary and every C-th window *within* each epoch
-        (aligned to the epoch start, NOT the resume point, so a resumed
-        run reuses the identical chunk grid): each dispatch's data is
-        then one contiguous epoch-relative slice of <= C windows, the
-        unit the ChunkFeed transfers."""
-        remaining = total_w - start_w
-        if remaining <= 0:
-            return []
-        bounds = {total_w}
-        if self.callbacks:
-            first = (start_w // wpe + 1) * wpe
-            bounds |= set(range(first, total_w, wpe))
-        cadence = self._ckpt_cadence_windows(wpe)
-        if cadence:
-            bounds |= set(range(start_w + cadence, total_w, cadence))
-        if data_chunk:
-            # k=0 of the grid below lands on every epoch boundary too
-            first_epoch = start_w // wpe
-            for e in range(first_epoch, -(-total_w // wpe)):
-                bounds |= {e * wpe + k for k in range(0, wpe, data_chunk)
-                           if start_w < e * wpe + k}
-        cuts = sorted(b for b in bounds if start_w < b <= total_w)
-        out, prev = [], start_w
-        for b in cuts:
-            out.append(b - prev)
-            prev = b
-        return out
-
     def _ckpt_cadence_windows(self, wpe):
         """Save cadence in WINDOW units — the single source both the
         chunk plan and the save decision use, so dispatch boundaries and
@@ -161,22 +125,6 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         if self.checkpoint_every:
             return self.checkpoint_every * wpe
         return None
-
-    def _ckpt_due_windows(self, windows_done, total_w):
-        """True when a save is owed at this window count — the dispatch
-        loop's sync-boundary predicate (a due save forces the pipeline
-        flush that makes the state fetchable)."""
-        if self._checkpointer_or_none() is None:
-            return False
-        last = getattr(self, "_last_ckpt_epoch", 0)  # in window units here
-        cadence = (self._ckpt_cadence_windows(self._wpe)
-                   or self.num_epoch * self._wpe)
-        return windows_done - last >= cadence or windows_done >= total_w
-
-    def _maybe_checkpoint_windows(self, windows_done, total_w, state_fn):
-        if self._ckpt_due_windows(windows_done, total_w):
-            self._checkpointer_or_none().save(windows_done, state_fn())
-            self._last_ckpt_epoch = windows_done
 
     # --- shared training loop ------------------------------------------
     def train(self, dataset, shuffle=False):
@@ -190,8 +138,6 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         chunks, so a preemption loses at most one cadence of windows.
         The reference analogue: a long-lived worker's state persists
         across its entire partition pass (workers.py:~150)."""
-        import time as _time
-
         model, loss_fn, tx = self._resolve()
         tx = self.wrap_optimizer(tx)
         if shuffle:
@@ -217,7 +163,6 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         # this host's slice when multi-host, see base._shards)
         xs = xs[:, :wpe * W].reshape(xs.shape[0], wpe, W, *xs.shape[2:])
         ys = ys[:, :wpe * W].reshape(ys.shape[0], wpe, W, *ys.shape[2:])
-        self._wpe = wpe
         total_w = self.num_epoch * wpe
 
         mesh = self.mesh
@@ -314,141 +259,35 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             opt_state = restored["opt_state"]
             rng = restored["rng"]
 
-        # ---- streaming decision: per-DEVICE residency is the HBM
-        # constraint (each device holds its own worker's epoch shard)
-        stream_C = self.stream_chunk_windows
-        per_device_epoch_bytes = (xs.nbytes + ys.nbytes) // max(
-            1, xs.shape[0])
-        if (stream_C is None and self.max_resident_bytes
-                and per_device_epoch_bytes > self.max_resident_bytes):
-            per_window = max(1, per_device_epoch_bytes // wpe)
-            # two chunks in flight (executing + prefetched) must fit
-            stream_C = max(1, self.max_resident_bytes // (2 * per_window))
-        if stream_C:
-            stream_C = max(1, min(int(stream_C), wpe))
-        self._streamed = bool(stream_C)
-
-        plan = self._window_chunk_plan(start_w, total_w, wpe,
-                                       data_chunk=stream_C)
-        if stream_C:
-            from dist_keras_tpu.data.feed import ChunkFeed
-
-            w, spans = start_w, []
-            for K in plan:
-                spans.append((w % wpe, K))  # epoch-relative slice
-                w += K
-            feed = ChunkFeed(spans, self._put_worker_chunk, xs, ys)
-            self._last_feed = feed  # test introspection
-            # chunk 0's transfer and the carry state land OUTSIDE the
-            # clock, like the resident path's one-shot H2D; chunks 1..
-            # transfer inside it, overlapped under the running dispatch
-            # (plan may be empty: resume of an already-finished run)
-            first = feed.get(0) if plan else ()
-            drain(center, local, opt_state, rng, *first)
-        else:
-            xs = self._to_device(xs)
-            ys = self._to_device(ys)
-            # data AND carry-state distribution completes OUTSIDE the
-            # clock (the stacked local/opt_state device_puts are async
-            # too)
-            drain(xs, ys, center, local, opt_state, rng)
         key = jax.random.PRNGKey(self.seed)
-        samples_per_window = self.num_workers * W * self.batch_size
 
-        self.record_training_start()
-        all_losses = []
-        windows_done = start_w
-        # metrics/callbacks fire at EPOCH boundaries only (integer epoch
-        # numbers, like every other trainer); chunks ending mid-epoch
-        # accumulate into the next boundary's emit
-        acc_losses, acc_dt, acc_samples = [], 0.0, 0
-        # Streamed chunks PIPELINE: losses of chunk i are fetched only
-        # when (a) a second chunk is already in flight (depth-2 bound so
-        # the feed's two-buffer residency guarantee holds) or (b) a sync
-        # boundary (epoch end / checkpoint due / final chunk) arrives.
-        # Non-boundary chunks thus cost no tunnel round trip — the sync
-        # cadence is per-epoch, not per-chunk.  Resident-mode chunks end
-        # only at boundaries, so its behavior is exactly the round-3 loop.
-        pending = []  # [(chunk_idx, device losses)]
+        def dispatch(i, K, windows_done, data):
+            nonlocal center, local, opt_state, rng
+            if self._streamed:
+                fn = self._compiled(lambda: build_chunk(K, streamed=True),
+                                    extra_key=("stream", K, wpe))
+            else:
+                fn = self._compiled(lambda: build_chunk(K),
+                                    extra_key=(K, wpe))
+            center, local, opt_state, rng, losses = fn(
+                center, local, opt_state, rng, *data, key,
+                jnp.int32(windows_done))
+            return losses
 
-        def _retire_one():
-            j, lj = pending.pop(0)
-            arr = np.asarray(comm.fetch_global(lj))  # blocks until j done
-            if stream_C:
-                feed.release(j)
-            all_losses.append(arr)
-            acc_losses.append(arr)
-
-        t_mark = _time.time()
-        try:
-            for i, K in enumerate(plan):
-                if stream_C:
-                    fn = self._compiled(
-                        lambda: build_chunk(K, streamed=True),
-                        extra_key=("stream", K, wpe))
-                    data = feed.get(i)
-                else:
-                    fn = self._compiled(lambda: build_chunk(K),
-                                        extra_key=(K, wpe))
-                    data = (xs, ys)
-                center, local, opt_state, rng, losses = fn(
-                    center, local, opt_state, rng, *data, key,
-                    jnp.int32(windows_done))
-                pending.append((i, losses))
-                windows_done += K
-                if stream_C:
-                    # retire the previous chunk BEFORE prefetching the
-                    # next: at most two chunks' data is ever
-                    # device-resident, and the i+1 transfer still
-                    # overlaps chunk i's execution
-                    while len(pending) > 1:
-                        _retire_one()
-                    feed.prefetch(i + 1)
-                boundary = (windows_done % wpe == 0
-                            or i == len(plan) - 1
-                            or self._ckpt_due_windows(windows_done,
-                                                      total_w))
-                acc_samples += samples_per_window * K
-                if not boundary:
-                    continue
-                drain(center)  # block_until_ready lies via the tunnel
-                acc_dt += _time.time() - t_mark
-                # host-side work below (loss fetches, checkpoint I/O,
-                # user callbacks) stays OUTSIDE the clock, as round 3
-                while pending:
-                    _retire_one()
-                # save BEFORE user callbacks run: a callback that dies
-                # (preemption simulation) must not lose the chunk
-                self._maybe_checkpoint_windows(
-                    windows_done, total_w,
-                    lambda: {"center": center, "local": local,
-                             "opt_state": opt_state, "rng": rng})
-                if windows_done % wpe == 0:
-                    self._emit_epoch_end(windows_done // wpe,
-                                         np.concatenate(acc_losses,
-                                                        axis=1),
-                                         acc_dt, acc_samples)
-                    acc_losses, acc_dt, acc_samples = [], 0.0, 0
-                t_mark = _time.time()
-        finally:
-            # exception-safe (a raising user callback must not leave the
-            # feed pinning the host epoch tensors for the trainer's life)
-            if stream_C:
-                feed.close()  # keeps stats, frees data references
-        self.record_training_end()
-
-        if all_losses:
-            flat = np.concatenate(all_losses, axis=1)  # (workers, tw, W)
-            # (workers, epochs, windows, W) for runs that executed whole
-            # epochs — the standard case, and the round-2 get_history
-            # contract.  A run RESUMED mid-epoch executed a partial first
-            # epoch, so its own history stays (workers, windows, W); see
-            # Trainer.get_history.
-            if flat.shape[1] % wpe == 0:
-                flat = flat.reshape(flat.shape[0], -1, wpe, W)
-            history = flat.tolist()
-        else:
-            history = []
+        # history entries are (workers, K, W) per chunk; run_chunked
+        # reshapes whole-epoch runs to the round-2 get_history contract
+        # (workers, epochs, windows, W) — a run RESUMED mid-epoch stays
+        # (workers, windows, W)
+        history = run_chunked(
+            self, xs, ys, start=start_w, total=total_w, per_epoch=wpe,
+            stream_units=self.stream_chunk_windows,
+            cadence=self._ckpt_cadence_windows(wpe),
+            samples_per_unit=self.num_workers * W * self.batch_size,
+            dispatch=dispatch, sync_ref=lambda: center,
+            state_fn=lambda: {"center": center, "local": local,
+                              "opt_state": opt_state, "rng": rng},
+            carry_leaves=(center, local, opt_state, rng),
+            fetch_global=comm.fetch_global)
         return self._finalize(center, history)
 
 

@@ -19,7 +19,7 @@ import pytest
 from dist_keras_tpu.data import Dataset
 from dist_keras_tpu.data.feed import ChunkFeed
 from dist_keras_tpu.models import mnist_mlp
-from dist_keras_tpu.trainers import ADAG, DOWNPOUR
+from dist_keras_tpu.trainers import ADAG, DOWNPOUR, DynSGD
 
 
 def _model():
@@ -160,6 +160,46 @@ def test_stream_feed_closed_after_crash(blobs_dataset):
     with pytest.raises(_Die):
         t.train(blobs_dataset)
     assert t._last_feed._arrays == ()  # closed despite the exception
+
+
+# ---------------------------------------------------------------------------
+# DynSGD through the same machinery (step-granular chunking)
+# ---------------------------------------------------------------------------
+def test_dynsgd_stream_parity(blobs_dataset):
+    t_res, m_res = _train(DynSGD, blobs_dataset)
+    t_str, m_str = _train(DynSGD, blobs_dataset, stream_chunk_windows=2)
+    assert not t_res._streamed and t_str._streamed
+    _params_equal(m_res, m_str)
+    np.testing.assert_array_equal(np.asarray(t_res.get_history()),
+                                  np.asarray(t_str.get_history()))
+    assert t_str._last_feed.peak_resident_chunks <= 2
+
+
+def test_dynsgd_mid_epoch_resume_bit_exact(tmp_path, blobs_dataset):
+    """checkpoint_every_windows saves DynSGD's staggered state (pulled
+    snapshots, staleness counters, in-epoch rng) MID-epoch; a resumed run
+    is bit-equal to the uninterrupted one."""
+    _, m_full = _train(DynSGD, blobs_dataset)
+
+    ck = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def bomb(trainer, epoch, logs):
+        calls["n"] += 1
+        raise _Die()
+
+    kw = dict(num_workers=4, worker_optimizer="sgd",
+              optimizer_kwargs={"learning_rate": 0.05}, batch_size=8,
+              num_epoch=2, label_col="label_encoded",
+              communication_window=4, checkpoint_dir=ck,
+              checkpoint_every_windows=3)  # 12 steps: NOT an epoch divisor
+    t = DynSGD(_model(), callbacks=[bomb], **kw)
+    with pytest.raises(_Die):
+        t.train(blobs_dataset)
+    assert calls["n"] == 1
+    t2 = DynSGD(_model(), resume=True, **kw)
+    m_resumed = t2.train(blobs_dataset)
+    _params_equal(m_full, m_resumed)
 
 
 # ---------------------------------------------------------------------------
