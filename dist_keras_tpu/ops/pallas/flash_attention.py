@@ -29,6 +29,15 @@ above the causal diagonal are skipped with ``pl.when``.
 
 Off-TPU (tests, CPU meshes) the same kernels run under ``interpret=True``;
 ``attention_auto`` dispatches per backend at trace time.
+
+Precision: probability tiles ``p`` (and ``ds`` in the backward) are
+computed in f32 and DOWNCAST TO THE INPUT DTYPE before the MXU matmuls —
+on the bf16 trainer path the attention weights lose mantissa per
+block-accumulate relative to all-f32 tiles (accumulation itself stays
+f32; parity tests pass at the documented tolerances).  This is a
+deliberate speed/precision trade: bf16xbf16 runs the MXU at full rate.
+The opt-out is the input dtype itself — pass f32 q/k/v and every matmul
+(including p/ds) runs in f32.
 """
 
 from __future__ import annotations
@@ -151,6 +160,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = lse.astype(lse_ref.dtype)   # (BQ, 1)
 
 
+def _kv_index_map(causal, block_q, block_k, q_offset, kv_offset):
+    """K/V index map for (bh, q_blocks, kv_blocks=innermost) grids.
+
+    For causal attention, tiles strictly above the diagonal are skipped
+    by ``pl.when`` — but Pallas still DMAs each grid step's blocks into
+    VMEM, so at long T nearly half the K/V bandwidth went to dead tiles.
+    Clamping the kv index to the last *visible* block makes every skipped
+    step re-address the block already in VMEM; Pallas elides the copy
+    when the index is unchanged, so masked tiles cost no HBM traffic."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def index(b, i, j):
+        jmax = jnp.maximum(
+            (q_offset + (i + 1) * block_q - 1 - kv_offset) // block_k, 0)
+        return (b, jnp.minimum(j, jmax), 0)
+
+    return index
+
+
 def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
               kv_offset, interpret):
     """q: (BH, Tq, D), k/v: (BH, Tk, D) -> (out (BH,Tq,D), lse (BH,Tq))."""
@@ -160,13 +189,14 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
+    kv_map = _kv_index_map(causal, block_q, block_k, q_offset, kv_offset)
     return pl.pallas_call(
         kernel,
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -293,7 +323,8 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
                   block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qrow = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kv_map = _kv_index_map(causal, block_q, block_k, q_offset, kv_offset)
+    kspec = pl.BlockSpec((1, block_k, d), kv_map)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         grid=(bh, tq // block_q, tk // block_k),
@@ -304,9 +335,20 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         interpret=interpret,
         **_compiler_params(interpret),
     )(q, k, v, do, lse, dl)
-    # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    qrow2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block.
+    # Causal skipped tiles sit at the START of the inner q loop here
+    # (q blocks above the diagonal); clamping the q index UP to the
+    # first visible block elides their DMAs (see _kv_index_map).
+    nq = tq // block_q
+    if causal:
+        def _q_clamp(b, i, j):
+            jmin = jnp.clip(
+                (kv_offset + i * block_k - q_offset) // block_q, 0, nq - 1)
+            return (b, jnp.maximum(j, jmin), 0)
+    else:
+        _q_clamp = lambda b, i, j: (b, j, 0)  # noqa: E731
+    qspec2 = pl.BlockSpec((1, block_q, d), _q_clamp)
+    qrow2 = pl.BlockSpec((1, block_q, 1), _q_clamp)
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, **common),
@@ -384,7 +426,7 @@ def _from_bh(x, b, h):
 
 
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=512, block_k=2048, q_offset=0,
+                             block_q=1024, block_k=1024, q_offset=0,
                              kv_offset=0, interpret=False):
     """q,k,v: (B, T, H, D) -> (out (B,T,H,D), lse (B,H,T) float32).
 
@@ -405,8 +447,8 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
     return _from_bh(out, b, h), lse.reshape(b, h, tq)  # lse (BH, T, 1)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=2048, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
+                    block_k=1024, interpret=False):
     """Pallas attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                       block_q=block_q, block_k=block_k,
@@ -414,8 +456,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     return out
 
 
-def attention_auto(q, k, v, causal=False, scale=None, block_q=512,
-                   block_k=2048):
+def attention_auto(q, k, v, causal=False, scale=None, block_q=1024,
+                   block_k=1024):
     """Backend-dispatching attention: Pallas kernel on TPU, jnp reference
     elsewhere.  Decided at trace time via ``jax.default_backend()`` so it
     works under jit/shard_map (tracers carry no device info)."""

@@ -127,7 +127,7 @@ def test_tp_remat_matches_plain():
     y = rng.integers(0, 3, 4).astype(np.int32)
 
     results = []
-    for remat in (False, True):
+    for remat in (False, True, "mlp"):
         factory, init_fn = make_tp_train_step(
             mesh, cfg, optimizer=optax.sgd(0.1), causal=True, remat=remat)
         params, opt_state = init_fn(0)
@@ -135,8 +135,9 @@ def test_tp_remat_matches_plain():
         p1, _, loss = fn(params, opt_state, jnp.asarray(x),
                          jnp.asarray(y))
         results.append((float(loss), p1))
-    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
-    jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=1e-6),
-        results[0][1], results[1][1])
+    for other in results[1:]:
+        np.testing.assert_allclose(results[0][0], other[0], rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6),
+            results[0][1], other[1])
