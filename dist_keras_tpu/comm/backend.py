@@ -102,15 +102,27 @@ def local_data_slice(n_rows, process=None, count=None):
     return start, stop
 
 
-def barrier():
-    """Block until every device reaches this point (one tiny cross-device
-    reduction; the float() forces host-side completion)."""
+def barrier(tag="dist_keras_tpu_barrier"):
+    """Block until every PROCESS reaches this point.
+
+    Multi-host: ``multihost_utils.sync_global_devices`` — a named psum
+    across all hosts' devices (``device_put`` onto an all-devices
+    sharding, the round-3 implementation, raises on non-addressable
+    devices and could never have worked beyond one process).
+    Single-process: a tiny all-device reduction with a blocking fetch.
+    Returns the number of participating devices.
+    """
+    devs = jax.devices()
+    if is_multi_host():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+        return len(devs)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devs = jax.devices()
     mesh = Mesh(np.array(devs), ("i",))
     x = jax.device_put(jnp.ones((len(devs),)), NamedSharding(mesh, P("i")))
-    return float(jnp.sum(x))
+    return int(float(jnp.sum(x)))
 
 
 def fetch_global(tree):

@@ -128,15 +128,20 @@ class Dataset:
     # TPU exits: fixed-shape batch tensors
     # ------------------------------------------------------------------
     def batches(self, batch_size, features_col="features", label_col="label",
-                drop_remainder=True):
+                drop_remainder=True, dtype=np.float32):
         """-> (num_batches, batch, ...) feature and label arrays.
 
         Fixed shapes so one jit covers every batch; the remainder is dropped
         exactly like the reference's fixed mini-batch assembly
-        (workers.py:~60).
+        (workers.py:~60).  ``dtype=None`` keeps the columns' own dtypes —
+        the host->device transfer then ships e.g. uint8 image bytes at 1/4
+        the float32 volume and the train step casts on-device (the
+        reference feeds uint8 MNIST through the same cast-late pattern).
         """
-        x = np.asarray(self._cols[features_col], dtype=np.float32)
-        y = np.asarray(self._cols[label_col], dtype=np.float32)
+        x = np.asarray(self._cols[features_col],
+                       dtype=dtype or self._cols[features_col].dtype)
+        y = np.asarray(self._cols[label_col],
+                       dtype=dtype or self._cols[label_col].dtype)
         n = (len(x) // batch_size) * batch_size
         if n == 0:
             raise ValueError(
@@ -147,7 +152,8 @@ class Dataset:
         return xb, yb
 
     def worker_shards(self, num_workers, batch_size, features_col="features",
-                      label_col="label", worker_range=None):
+                      label_col="label", worker_range=None,
+                      dtype=np.float32):
         """-> (num_workers, steps, batch, ...) arrays for shard_map training.
 
         Rows are dealt to workers contiguously (the reference's repartition
@@ -161,6 +167,9 @@ class Dataset:
         the multi-host path: every host computes the identical global
         geometry from the full length, then slices its own workers' rows,
         so concatenating hosts' results equals the full deal.
+
+        ``dtype=None`` keeps the columns' own dtypes (uint8 image bytes
+        ship at 1/4 float32 H2D volume; the train step casts on-device).
         """
         x = self._cols[features_col]
         y = self._cols[label_col]
@@ -172,8 +181,8 @@ class Dataset:
                 f"{batch_size}: no full step")
         lo, hi = (0, num_workers) if worker_range is None else worker_range
         rows = slice(lo * steps * batch_size, hi * steps * batch_size)
-        x = np.asarray(x[rows], dtype=np.float32)
-        y = np.asarray(y[rows], dtype=np.float32)
+        x = np.asarray(x[rows], dtype=dtype or x.dtype)
+        y = np.asarray(y[rows], dtype=dtype or y.dtype)
         xs = x.reshape(hi - lo, steps, batch_size, *x.shape[1:])
         ys = y.reshape(hi - lo, steps, batch_size, *y.shape[1:])
         return xs, ys

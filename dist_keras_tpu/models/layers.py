@@ -370,7 +370,12 @@ class Dropout(Layer):
             # staples): threshold uint8 random bits — mask generation is
             # random-bit-bound on the VPU and 8-bit words quarter the
             # threefry work (~30% cheaper masks measured on v5e);
-            # P(bits < thresh) = thresh/256 = keep, exactly
+            # P(bits < thresh) = thresh/256 = keep, exactly.
+            # RNG-STREAM NOTE (round 3 change): this path samples a
+            # DIFFERENT mask stream than jax.random.bernoulli for the
+            # same key, so runs/checkpoints spanning the round-3 commit
+            # do not reproduce bit-identically at these rates (keep-rate
+            # itself is exact and tested)
             bits = jax.random.bits(rng, x.shape, jnp.uint8)
             mask = bits < thresh
         else:

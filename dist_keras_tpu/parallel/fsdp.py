@@ -130,8 +130,19 @@ def match_specs_for_state(params, pspecs, tree):
         treedef, [spec_for(path, leaf) for path, leaf in flat])
 
 
-# round-2 name; the shape-keyed implementation it refers to is gone
-match_specs_by_shape = match_specs_for_state
+def match_specs_by_shape(params, pspecs, opt_state):
+    """Deprecated round-2 name for :func:`match_specs_for_state`.
+
+    The shape-keyed implementation (and its shape-collision ValueError)
+    is gone; this now matches by tree-path suffix.  Warns on use; will be
+    removed next round."""
+    import warnings
+
+    warnings.warn(
+        "match_specs_by_shape is deprecated (semantics changed in round "
+        "3 from shape-keyed to path-suffix matching); call "
+        "match_specs_for_state instead", DeprecationWarning, stacklevel=2)
+    return match_specs_for_state(params, pspecs, opt_state)
 
 
 def make_fsdp_train_step(mesh, loss_fn, apply_fn, optimizer=None,

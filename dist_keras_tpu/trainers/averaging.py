@@ -150,21 +150,23 @@ class EnsembleTrainer(DistributedTrainer):
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         # one data shard per MODEL (reference: one partition per model);
-        # leading axis regrouped (slots, models_per_slot, steps, ...)
+        # leading axis regrouped (slots, models_per_slot, steps, ...).
+        # Multi-host: host h owns mesh slots [lo, hi), hence global model
+        # ids [lo*mps, hi*mps) — slice exactly those models' rows so the
+        # concatenation over hosts equals the single-host deal.
         mps = self.models_per_slot
-        mesh = self.mesh  # prime the slot mesh BEFORE the worker swap
-        if mps > 1 and comm.is_multi_host():
-            raise NotImplementedError(
-                "models_per_slot > 1 with multi-host feeding is not "
-                "supported yet; pass num_workers=num_models")
-        saved_workers = self.num_workers
-        self.num_workers = self.num_models
-        try:
-            xs, ys = self._shards(dataset)
-        finally:
-            self.num_workers = saved_workers
-        # -1, not self.num_workers: on multi-host _shards returns only
-        # this host's slots, so the leading dim is the LOCAL slot count
+        mesh = self.mesh  # prime the mesh (and multi-host bring-up)
+        if comm.is_multi_host():
+            lo, hi = self._local_worker_range()
+            model_range = (lo * mps, hi * mps)
+        else:
+            model_range = None
+        xs, ys = dataset.worker_shards(
+            self.num_models, self.batch_size,
+            features_col=self.features_col, label_col=self.label_col,
+            worker_range=model_range, dtype=self.data_dtype)
+        # -1, not self.num_workers: on multi-host only this host's
+        # models are materialized, so the leading dim is the LOCAL count
         xs = xs.reshape(-1, mps, *xs.shape[1:])
         ys = ys.reshape(-1, mps, *ys.shape[1:])
         step, opt_init = make_model_step(
@@ -250,9 +252,13 @@ class EnsembleTrainer(DistributedTrainer):
                         if all_losses else [])
 
         # one device->host transfer for the whole ensemble, then slice
+        # (fetch_global: multi-host gathers every host's slots so ALL
+        # hosts hold all models, matching the driver-side collect of the
+        # reference; np.asarray alone cannot read non-addressable shards)
         host = jax.tree.map(
             lambda x: np.asarray(x).reshape(
-                self.num_models, *x.shape[2:]), stacked)
+                self.num_models, *x.shape[2:]),
+            comm.fetch_global(stacked))
         models = []
         for i in range(self.num_models):
             m = self._fresh_model()

@@ -31,6 +31,7 @@ class Trainer:
                  worker_optimizer="adam", optimizer_kwargs=None,
                  features_col="features", label_col="label",
                  batch_size=32, num_epoch=1, seed=0, compute_dtype=None,
+                 data_dtype=np.float32,
                  checkpoint_dir=None, checkpoint_every=None,
                  max_checkpoints=3, resume=False, callbacks=None):
         self.serialized_model = serialize_model(keras_model)
@@ -43,6 +44,12 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
         self.compute_dtype = compute_dtype
+        # dtype the host batches are materialized (and H2D-shipped) in;
+        # None keeps the dataset columns' native dtypes — uint8 images
+        # then transfer at 1/4 the float32 volume and the train step
+        # casts on-device (cast-late, like the reference's uint8 MNIST
+        # feed).  float32 default = the round-1..3 behavior.
+        self.data_dtype = data_dtype
         # ---- mid-training hooks (beyond the reference: SURVEY §5 owes
         # checkpoint/resume + structured metrics) ----
         self.checkpoint_dir = checkpoint_dir
@@ -105,8 +112,11 @@ class Trainer:
     # Non-string key components are tokened by id(); pin them (dict keyed
     # by id, so repeated _cache_key calls — e.g. once per epoch chunk —
     # never duplicate) so a GC'd object's address can never be reused by a
-    # different config.
+    # different config.  Pins are refcounted per CACHE KEY and released
+    # when eviction drops the last key referencing them, so a long
+    # hyperparameter sweep can't leak one pinned object per point.
     _id_pins = {}
+    _id_pin_refs = {}
 
     def _cache_extras(self):
         """Subclass hook: hyperparameters baked into the trace."""
@@ -130,14 +140,40 @@ class Trainer:
                 str(self.compute_dtype),
                 self._cache_extras())
 
+    @staticmethod
+    def _key_obj_ids(key):
+        """ids of every ``obj:<id>`` token inside a (nested) cache key."""
+        out = []
+
+        def walk(t):
+            if isinstance(t, tuple):
+                for e in t:
+                    walk(e)
+            elif isinstance(t, str) and t.startswith("obj:"):
+                out.append(int(t[4:]))
+
+        walk(key)
+        return out
+
     def _compiled(self, builder, extra_key=()):
         key = self._cache_key() + tuple(extra_key)
         cache = Trainer._jit_cache
+        refs, pins = Trainer._id_pin_refs, Trainer._id_pins
         fn = cache.pop(key, None)
         if fn is None:
             fn = builder()
+            for i in Trainer._key_obj_ids(key):  # new key: pin its objs
+                refs[i] = refs.get(i, 0) + 1
             while len(cache) >= Trainer._jit_cache_max:
-                cache.pop(next(iter(cache)))  # evict least recently used
+                old_key = next(iter(cache))  # evict least recently used
+                cache.pop(old_key)
+                for i in Trainer._key_obj_ids(old_key):
+                    n = refs.get(i, 1) - 1
+                    if n <= 0:  # last key using this obj: unpin it
+                        refs.pop(i, None)
+                        pins.pop(i, None)
+                    else:
+                        refs[i] = n
         cache[key] = fn  # (re)insert at the back = most recent
         return fn
 
@@ -169,14 +205,27 @@ class Trainer:
                 self.checkpoint_dir, max_to_keep=self.max_checkpoints)
         return self._checkpointer
 
-    def _maybe_resume(self, template):
-        """-> (start_epoch, restored_state | None)."""
+    def _maybe_resume(self, template, incompatible_hint=None):
+        """-> (start_epoch, restored_state | None).
+
+        ``incompatible_hint``: actionable message appended when the
+        restore fails on a template/checkpoint structure mismatch (e.g.
+        a round-3 checkpoint without the round-4 'rng' leaf — orbax
+        raises its own opaque tree error long before a key check on the
+        restored dict could run)."""
         ckptr = self._checkpointer_or_none()
         if not (self.resume and ckptr is not None):
             return 0, None
         if ckptr.latest_step() is None:
             return 0, None
-        step, state = ckptr.restore(template=template)
+        try:
+            step, state = ckptr.restore(template=template)
+        except Exception as e:
+            if incompatible_hint:
+                raise ValueError(
+                    f"checkpoint restore failed ({type(e).__name__}); "
+                    f"{incompatible_hint}") from e
+            raise
         self._last_ckpt_epoch = int(step)
         return int(step), state
 
@@ -296,7 +345,8 @@ class DistributedTrainer(Trainer):
             self.num_workers, self.batch_size,
             features_col=self.features_col, label_col=self.label_col,
             worker_range=(self._local_worker_range()
-                          if comm.is_multi_host() else None))
+                          if comm.is_multi_host() else None),
+            dtype=self.data_dtype)
 
     def _to_device(self, x):
         """Host (local_workers, ...) array -> device array sharded over

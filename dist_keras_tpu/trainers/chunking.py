@@ -165,10 +165,13 @@ class ChunkRunner:
     - ``state_fn() -> dict`` — the checkpoint payload (lazy: only
       evaluated when a save is due).
 
-    Timing: only dispatch + drain are on the clock; loss fetches,
-    checkpoint I/O and user callbacks happen between ``t_mark`` resets,
-    exactly like the round-3 loop.  Streamed chunks pipeline at depth 2
-    so syncs happen per boundary (epoch/cadence), not per chunk.
+    Timing: boundary-time host work (loss fetches, checkpoint I/O, user
+    callbacks) happens between ``t_mark`` resets — off the clock, like
+    the round-3 loop.  The ONE exception is the streamed path's mid-loop
+    depth-2 backpressure retire: it blocks until the PREVIOUS chunk's
+    compute finishes (so at most two chunks' data is device-resident),
+    which is genuine training wall-time and is counted; the loss bytes
+    it also fetches are KBs riding that same round trip.
     """
 
     def __init__(self, trainer, *, plan, start, total, per_epoch,

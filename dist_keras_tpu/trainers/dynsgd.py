@@ -183,7 +183,13 @@ class DynSGD(AsynchronousDistributedTrainer):
         template = {"center": center, "pulled": pulled, "local": local,
                     "opt_state": opt_state, "last_seen": last_seen,
                     "global_count": global_count, "rng": rng}
-        start_t, restored = self._maybe_resume(template)
+        start_t, restored = self._maybe_resume(
+            template,
+            incompatible_hint=(
+                "if this checkpoint predates step-granular DynSGD "
+                "training state (round 3: no 'rng' leaf, step counted "
+                "epochs not steps), restart training or point "
+                "checkpoint_dir at a fresh directory"))
         if restored is not None:
             if "rng" not in restored:
                 raise ValueError(

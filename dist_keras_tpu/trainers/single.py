@@ -25,7 +25,8 @@ class SingleTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xb, yb = dataset.batches(
-            self.batch_size, self.features_col, self.label_col)
+            self.batch_size, self.features_col, self.label_col,
+            dtype=self.data_dtype)
 
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)

@@ -27,6 +27,10 @@ def make_loss_fn(apply_fn, loss_fn, compute_dtype=None, training=True):
         if compute_dtype is not None:
             params = tree_cast(params, compute_dtype)
             x = x.astype(compute_dtype)
+        elif not jnp.issubdtype(x.dtype, jnp.floating):
+            # cast-late input pipeline (data_dtype=None ships uint8):
+            # the cast happens here, on-device, not on the host
+            x = x.astype(jnp.float32)
         preds = apply_fn(params, x, training=training, rng=rng)
         return loss_fn(preds.astype(jnp.float32), y.astype(jnp.float32))
 
@@ -90,6 +94,8 @@ def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
         if compute_dtype is not None:
             params = cast(params, compute_dtype)
             x = x.astype(compute_dtype)
+        elif not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.float32)  # cast-late uint8 feed (see above)
         preds, new_state = model.apply_with_state(
             params, x, training=training, rng=rng)
         loss = loss_fn(preds.astype(jnp.float32), y.astype(jnp.float32))

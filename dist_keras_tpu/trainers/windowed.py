@@ -137,7 +137,15 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         replicas, optimizer state, the in-epoch rng) carried across
         chunks, so a preemption loses at most one cadence of windows.
         The reference analogue: a long-lived worker's state persists
-        across its entire partition pass (workers.py:~150)."""
+        across its entire partition pass (workers.py:~150).
+
+        Metrics cadence: per-epoch metrics/callbacks fire at dispatch
+        boundaries whose window count is an exact epoch multiple.  With
+        ``checkpoint_every_windows`` not dividing windows-per-epoch and
+        no callbacks registered, several epochs can collapse into one
+        metrics entry (nothing is lost — accumulators carry across and
+        the final emit always fires); register any callback to force
+        true epoch-boundary chunking."""
         model, loss_fn, tx = self._resolve()
         tx = self.wrap_optimizer(tx)
         if shuffle:
@@ -246,7 +254,13 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         rng = self._stack_workers(jnp.zeros((2,), jnp.uint32))
         template = {"center": center, "local": local,
                     "opt_state": opt_state, "rng": rng}
-        start_w, restored = self._maybe_resume(template)
+        start_w, restored = self._maybe_resume(
+            template,
+            incompatible_hint=(
+                "if this checkpoint predates window-granular training "
+                "state (round 2: no 'rng' leaf, step counted epochs not "
+                "windows), restart training or point checkpoint_dir at "
+                "a fresh directory"))
         if restored is not None:
             if "rng" not in restored:
                 raise ValueError(

@@ -229,3 +229,110 @@ def test_two_process_ep_matches_single_process(tmp_path):
     for _ in range(3):
         params, opt_state, _m = fn(params, opt_state, x, y)
     _assert_same(w0, w1, jax.tree.leaves(params))
+
+
+def _ensemble_body():
+    return r"""
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.trainers import EnsembleTrainer
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 8)).astype(np.float32)
+yv = rng.integers(0, 2, 256)
+ds = Dataset({"features": x, "label": yv, "label_encoded": one_hot(yv, 2)})
+t = EnsembleTrainer(mnist_mlp(hidden=(8,), input_dim=8, num_classes=2,
+                              seed=0),
+                    num_models=16, worker_optimizer="sgd",
+                    optimizer_kwargs={"learning_rate": 0.05}, batch_size=8,
+                    num_epoch=2, label_col="label_encoded", seed=0)
+models = t.train(ds)         # 8 slots x 2 models_per_slot over 2 hosts
+assert len(models) == 16
+mesh = t.mesh
+# multi-host barrier: the round-3 device_put version raised here
+nd = comm.barrier()
+assert nd == 8, nd
+import jax
+leaves = [np.stack([np.concatenate(
+    [np.asarray(l).ravel() for l in jax.tree.leaves(m.params)])
+    for m in models])]
+"""
+
+
+def test_two_process_ensemble_mps2_and_barrier(tmp_path):
+    """EnsembleTrainer with models_per_slot=2 over 2 hosts (the round-3
+    NotImplementedError hole) + the multi-host-safe barrier."""
+    w0, w1 = _run_pair(tmp_path, _ensemble_body())
+
+    import jax
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.trainers import EnsembleTrainer
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    yv = rng.integers(0, 2, 256)
+    ds = Dataset({"features": x, "label": yv,
+                  "label_encoded": one_hot(yv, 2)})
+    t = EnsembleTrainer(mnist_mlp(hidden=(8,), input_dim=8, num_classes=2,
+                                  seed=0),
+                        num_models=16, worker_optimizer="sgd",
+                        optimizer_kwargs={"learning_rate": 0.05},
+                        batch_size=8, num_epoch=2,
+                        label_col="label_encoded", seed=0)
+    models = t.train(ds)
+    ref = [np.stack([np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(m.params)])
+        for m in models])]
+    _assert_same(w0, w1, ref)
+
+
+def _pp_body():
+    return r"""
+import optax
+from dist_keras_tpu.models.transformer import transformer_config
+from dist_keras_tpu.parallel.pipeline import (make_pp_mesh,
+                                              train_pp_transformer)
+
+cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                         n_layers=8, n_classes=2)
+mesh = make_pp_mesh(stages=8)   # stages span BOTH hosts: the per-tick
+rng = np.random.default_rng(0)  # ppermute crosses the process boundary
+x = rng.normal(size=(8, 8, 4)).astype(np.float32)
+y = rng.integers(0, 2, 8).astype(np.int32)
+(rest, blocks), losses = train_pp_transformer(
+    mesh, cfg, x, y, num_microbatches=4, steps=3,
+    optimizer=optax.adam(1e-2), causal=True, seed=0)
+import jax
+leaves = jax.tree.leaves((rest, blocks))
+"""
+
+
+def test_two_process_pp_matches_single_process(tmp_path):
+    """1F1B pipeline over a stages axis spanning 2 processes — the
+    per-tick activation ppermute crosses the host boundary (round-3
+    VERDICT: exactly where a layout bug would hide)."""
+    w0, w1 = _run_pair(tmp_path, _pp_body())
+
+    import jax
+    import optax
+
+    from dist_keras_tpu.models.transformer import transformer_config
+    from dist_keras_tpu.parallel.pipeline import (
+        make_pp_mesh,
+        train_pp_transformer,
+    )
+
+    cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
+                             n_layers=8, n_classes=2)
+    mesh = make_pp_mesh(stages=8)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 8, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 8).astype(np.int32)
+    (rest, blocks), _ = train_pp_transformer(
+        mesh, cfg, x, y, num_microbatches=4, steps=3,
+        optimizer=optax.adam(1e-2), causal=True, seed=0)
+    _assert_same(w0, w1, jax.tree.leaves((rest, blocks)))

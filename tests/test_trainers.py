@@ -216,3 +216,74 @@ def test_ensemble_cache_key_distinguishes_num_models(blobs_dataset):
     m16 = EnsembleTrainer(_model(), num_models=16,
                           **kw).train(blobs_dataset)
     assert len(m8) == 8 and len(m16) == 16
+
+
+def test_uint8_cast_late_feed_matches_float32():
+    """data_dtype=None ships the columns' native uint8 bytes (1/4 the
+    float32 H2D volume) and casts on-device — bit-equal result."""
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(256, 8)).astype(np.uint8)
+    y = rng.integers(0, 2, size=256)
+    ds = Dataset({"features": x, "label": y,
+                  "label_encoded": one_hot(y, 2, dtype=np.uint8)})
+
+    def run(**kw):
+        t = ADAG(_model(), num_workers=4, worker_optimizer="sgd",
+                 optimizer_kwargs={"learning_rate": 0.001}, batch_size=16,
+                 num_epoch=2, label_col="label_encoded",
+                 communication_window=2, **kw)
+        return t, t.train(ds)
+
+    t32, m32 = run()                      # host-cast float32 (default)
+    tu8, mu8 = run(data_dtype=None)       # native uint8, cast on device
+    for a, b in zip(jax.tree.leaves(m32.params),
+                    jax.tree.leaves(mu8.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # SingleTrainer path too
+    st32 = SingleTrainer(_model(), worker_optimizer="sgd",
+                         optimizer_kwargs={"learning_rate": 0.001},
+                         batch_size=16, num_epoch=1,
+                         label_col="label_encoded")
+    stu8 = SingleTrainer(_model(), worker_optimizer="sgd",
+                         optimizer_kwargs={"learning_rate": 0.001},
+                         batch_size=16, num_epoch=1,
+                         label_col="label_encoded", data_dtype=None)
+    for a, b in zip(jax.tree.leaves(st32.train(ds).params),
+                    jax.tree.leaves(stu8.train(ds).params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_id_pins_pruned_with_cache_eviction():
+    """The compiled-program cache's object pins are released when LRU
+    eviction drops the last key referencing them (round-3 leaked one
+    pinned object per hyperparameter-sweep point)."""
+    from dist_keras_tpu.trainers.base import Trainer
+
+    saved = (dict(Trainer._jit_cache), dict(Trainer._id_pins),
+             dict(Trainer._id_pin_refs), Trainer._jit_cache_max)
+    Trainer._jit_cache.clear()
+    Trainer._id_pins.clear()
+    Trainer._id_pin_refs.clear()
+    Trainer._jit_cache_max = 4
+    try:
+        m = _model()
+        losses = [(lambda p, y, _i=i: 0.0) for i in range(12)]  # distinct
+        for lo in losses:
+            t = SingleTrainer(m, loss=lo)
+            t._compiled(lambda: object())
+        assert len(Trainer._jit_cache) <= 4
+        # only the losses still referenced by live cache keys stay pinned
+        assert len(Trainer._id_pins) <= 4
+        assert len(Trainer._id_pin_refs) == len(Trainer._id_pins)
+    finally:
+        Trainer._jit_cache.clear()
+        Trainer._jit_cache.update(saved[0])
+        Trainer._id_pins.clear()
+        Trainer._id_pins.update(saved[1])
+        Trainer._id_pin_refs.clear()
+        Trainer._id_pin_refs.update(saved[2])
+        Trainer._jit_cache_max = saved[3]
