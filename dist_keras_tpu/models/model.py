@@ -41,8 +41,29 @@ class Sequential:
     # build / init
     # ------------------------------------------------------------------
     def build(self, input_shape, seed=0):
-        """Initialise parameters for ``input_shape`` (no batch dim)."""
-        self.params = self.init(jax.random.PRNGKey(seed), tuple(input_shape))
+        """Initialise parameters for ``input_shape`` (no batch dim).
+
+        Init runs on the HOST CPU backend and the params are materialized
+        as numpy: a freshly-built model is device-free (the reference
+        builds on the Spark driver the same way), so serialize_model
+        never round-trips weights through the accelerator — on a
+        remote-tunnel TPU backend, device-resident init made serializing
+        a 336 MB model cost ~60 s of D2H at tunnel bandwidth.  Trainers
+        ship the numpy params with ONE device_put when training starts."""
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:  # pragma: no cover - cpu platform disabled
+            cpu = None
+        if cpu is not None:
+            with jax.default_device(cpu):
+                params = self.init(jax.random.PRNGKey(seed),
+                                   tuple(input_shape))
+        else:
+            params = self.init(jax.random.PRNGKey(seed),
+                               tuple(input_shape))
+        import numpy as _np
+
+        self.params = jax.tree.map(_np.asarray, params)
         return self
 
     def init(self, key, input_shape):

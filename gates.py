@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Run the accuracy gates and emit a machine-readable GATES_r{N}.json.
+
+VERDICT r3 #4: the full-tier gates enforced real thresholds but their
+measured accuracies lived only as README prose — nothing machine-readable
+proved the five BASELINE configs passed.  This driver runs
+``tests/test_examples.py`` (full tier by default; ``--fast`` for the CI
+tier), collects the ``GATE_RESULT`` lines each gate prints (see
+``tests/test_examples.py:_gate``), and writes
+``GATES_r{ROUND}.json``::
+
+    {"round": N, "tier": "full", "all_passed": true,
+     "environment": {...}, "gates": [
+        {"name": "adag_mnist_cnn_w12", "metric": "accuracy",
+         "value": 0.93, "threshold": 0.9, "passed": true, ...}, ...]}
+
+Environment note: the multi-worker gates need a worker mesh, so they run
+on the canonical 8-virtual-device CPU harness (tests/conftest.py — the
+``local[8]`` Spark-master analogue; a single physical TPU chip cannot
+host a 4- or 8-worker mesh).  The recorded ``environment`` block says
+exactly what ran where.
+
+Usage:  python gates.py [--fast] [--round N] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def run_gates(fast=False, timeout=3 * 3600):
+    cmd = [sys.executable, "-m", "pytest", "tests/test_examples.py",
+           "-q", "-s", "-p", "no:cacheprovider"]
+    if fast:
+        cmd.append("--fast")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+    out = proc.stdout + "\n" + proc.stderr
+    gates = [json.loads(m.group(1)) for m in
+             re.finditer(r"GATE_RESULT (\{.*\})", out)]
+    return {
+        "exit_code": proc.returncode,
+        "seconds": round(time.time() - t0, 1),
+        "gates": gates,
+        "tail": out.strip().splitlines()[-3:],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="CI tier (minutes) instead of the full tier")
+    ap.add_argument("--round", type=int,
+                    default=int(os.environ.get("GRAFT_ROUND", 4)))
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    res = run_gates(fast=args.fast)
+    import platform
+
+    doc = {
+        "round": args.round,
+        "tier": "fast" if args.fast else "full",
+        "all_passed": (res["exit_code"] == 0 and bool(res["gates"])
+                       and all(g["passed"] for g in res["gates"])),
+        "pytest_exit_code": res["exit_code"],
+        "seconds": res["seconds"],
+        "environment": {
+            "harness": "8-virtual-device CPU mesh (tests/conftest.py); "
+                       "multi-worker gates need a worker mesh a single "
+                       "TPU chip cannot host",
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+        },
+        "gates": res["gates"],
+        "tail": res["tail"],
+    }
+    out = args.out or os.path.join(REPO, f"GATES_r{args.round:02d}.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"wrote": out, "all_passed": doc["all_passed"],
+                      "n_gates": len(res["gates"]),
+                      "seconds": res["seconds"]}))
+    return 0 if doc["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

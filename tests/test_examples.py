@@ -161,6 +161,21 @@ def _accuracy(model, test, features_col):
                              label_col="label").evaluate(pred)
 
 
+def _gate(name, metric, value, threshold, tier_fast, detail=""):
+    """Record a gate result as a parseable line (gates.py collects these
+    into the round's GATES_r*.json artifact), then enforce it."""
+    import json as _json
+
+    rec = {"name": name, "metric": metric, "value": float(value),
+           "threshold": float(threshold),
+           "passed": bool(value >= threshold),
+           "tier": "fast" if tier_fast else "full"}
+    if detail:
+        rec["detail"] = detail
+    print(f"GATE_RESULT {_json.dumps(rec)}", flush=True)
+    assert value >= threshold, f"{name} {metric} {value} < {threshold}"
+
+
 # ---------------------------------------------------------------------------
 # gate 1: SingleTrainer — MNIST MLP (through the CSV ingestion path)
 # ---------------------------------------------------------------------------
@@ -178,7 +193,7 @@ def test_single_mnist_mlp(tmp_path, mnist_test, G):
                       features_col="fn", label_col="le")
     trained = t.train(train, shuffle=True)
     acc = _accuracy(trained, mnist_test, "fn")
-    assert acc >= G["acc"], f"SingleTrainer MNIST MLP accuracy {acc}"
+    _gate("single_mnist_mlp", "accuracy", acc, G["acc"], G["fast"])
 
 
 # ---------------------------------------------------------------------------
@@ -192,7 +207,7 @@ def test_adag_mnist_cnn(mnist_train, mnist_test, G):
              features_col="fi", label_col="le")
     trained = t.train(mnist_train, shuffle=True)
     acc = _accuracy(trained, mnist_test, "fi")
-    assert acc >= G["acc"], f"ADAG MNIST CNN accuracy {acc}"
+    _gate("adag_mnist_cnn_w12", "accuracy", acc, G["acc"], G["fast"])
 
 
 # ---------------------------------------------------------------------------
@@ -212,7 +227,8 @@ def test_downpour_mnist_cnn(mnist_train, mnist_test, G):
     acc = _accuracy(trained, mnist_test, "fi")
     # fast tier checks the early curve (the warmup spans half the run);
     # the full tier enforces the real accuracy bar
-    assert acc >= G["acc_downpour"], f"DOWNPOUR MNIST CNN accuracy {acc}"
+    _gate("downpour_mnist_cnn_8w", "accuracy", acc, G["acc_downpour"],
+          G["fast"])
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +250,7 @@ def test_aeasgd_eamsgd_higgs(higgs_data, cls, extra, G):
     pred = ModelPredictor(trained, features_col="fs").predict(test)
     auc = AUCEvaluator(score_col="prediction",
                        label_col="label").evaluate(pred)
-    assert auc >= G["auc"], f"{cls.__name__} Higgs AUC {auc}"
+    _gate(f"{cls.__name__.lower()}_higgs", "auc", auc, G["auc"], G["fast"])
 
 
 # ---------------------------------------------------------------------------
@@ -272,10 +288,12 @@ def test_dynsgd_cifar10_parity(cifar_data, G):
                optimizer_kwargs={"learning_rate": 2e-3},
                num_epoch=e_dynsgd, **common)
     acc = _accuracy(t.train(train, shuffle=True), test, "fi")
-    assert acc >= acc_control - 0.02, (
-        f"DynSGD CIFAR-10 {acc} vs staleness-normalized control "
-        f"{acc_control} ({e_dynsgd} vs {e_control} epochs)")
-    assert acc >= 2.5 * 0.10, f"DynSGD CIFAR-10 accuracy {acc} near chance"
+    _gate("dynsgd_cifar10_vs_control", "accuracy", acc,
+          acc_control - 0.02, G["fast"],
+          detail=f"staleness-normalized control {acc_control:.3f} "
+                 f"({e_dynsgd} vs {e_control} epochs)")
+    _gate("dynsgd_cifar10_above_chance", "accuracy", acc, 2.5 * 0.10,
+          G["fast"])
 
 
 # ---------------------------------------------------------------------------
