@@ -91,8 +91,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(scope="session")
 def G(fast_gates):
     if fast_gates:  # CI tier: one CPU core, minutes
+        # mnist_n=3072: 3072/(4 workers x batch 64) = 12 steps/worker,
+        # so the ADAG gate runs communication_window=12 AS WRITTEN even
+        # at this tier (2048 rows silently shrank the window to 8)
         return dict(fast=True, acc=0.80, auc=0.80, acc_downpour=0.30,
-                    mnist_n=2048, test_n=512,
+                    mnist_n=3072, test_n=512,
                     higgs_n=4096, higgs_test=1024,
                     cifar_n=1024, cifar_test=256,
                     ep_single=4, ep_adag=4, ep_downpour=8, ep_aeasgd=5,
