@@ -507,7 +507,27 @@ def bench_adag_streamed(peak):
     }
 
 
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache (verified to work through the
+    axon remote-compile tunnel: 2nd process compile 3.9 s -> 0.1 s).
+    The transformer config's cold compile costs ~40 min through the
+    tunnel; with the cache warmed by any earlier bench run on this
+    machine, a re-run skips it entirely.  Harmless when cold."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # pragma: no cover - older jax without the knobs
+        pass
+
+
 def main():
+    _enable_compilation_cache()
     peak = _peak_flops()
     configs = []
     for fn in (bench_adag_mnist_cnn, bench_aeasgd_higgs,
