@@ -51,7 +51,11 @@ class Sequential:
         a 336 MB model cost ~60 s of D2H at tunnel bandwidth.  Trainers
         ship the numpy params with ONE device_put when training starts."""
         try:
-            cpu = jax.devices("cpu")[0]
+            # local_devices, not devices: on a multi-process group the
+            # latter's device 0 belongs to process 0, and pinning another
+            # process's default_device to it routes this purely-local
+            # init through cross-host Gloo collectives (which time out)
+            cpu = jax.local_devices(backend="cpu")[0]
         except RuntimeError:  # pragma: no cover - cpu platform disabled
             cpu = None
         if cpu is not None:

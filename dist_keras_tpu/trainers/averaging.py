@@ -21,6 +21,7 @@ from dist_keras_tpu.parallel.collectives import tree_pmean_sync, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
+from dist_keras_tpu.trainers.chunking import run_chunked
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.sync import drain
 
@@ -31,83 +32,162 @@ except ImportError:  # older jax
 
 
 class AveragingTrainer(DistributedTrainer):
-    def train(self, dataset, shuffle=False):
-        import time as _time
+    """Per-epoch weight averaging (trainers.py:~160).
 
+    Round 4: the run is a flat scan over GLOBAL steps through the shared
+    ``ChunkRunner`` — per-worker local state is re-initialized at each
+    epoch's first step and ``pmean``-merged at its last (identical math
+    to the round-3 per-epoch scan), which buys the same streaming feed as
+    the rest of the family (``stream_chunk_steps`` counts chunks in
+    STEPS here; ``max_resident_bytes`` auto-switches)."""
+
+    def __init__(self, keras_model, stream_chunk_steps=None,
+                 max_resident_bytes=None, **kw):
+        super().__init__(keras_model, **kw)
+        from dist_keras_tpu.trainers.chunking import init_streaming
+
+        init_streaming(self, stream_chunk_steps, max_resident_bytes,
+                       name="stream_chunk_steps")
+
+    def train(self, dataset, shuffle=False):
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
+        spe = xs.shape[1]
+        total_t = self.num_epoch * spe
         mesh = self.mesh
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
+        key = jax.random.PRNGKey(self.seed)
 
-        def build_chunk(E):
-            def body(params, xs, ys, key, epoch0):
-                xs, ys = xs[0], ys[0]  # shard -> local (steps, batch, ...)
+        def build_chunk(T, streamed=False):
+            def body(params, local, opt_state, rng, xs, ys, key, t0):
+                xs, ys = xs[0], ys[0]
                 widx = jax.lax.axis_index(WORKER_AXIS)
+                local = jax.tree.map(lambda a: a[0], local)
+                opt_state = jax.tree.map(lambda a: a[0], opt_state)
+                rng = rng[0]
 
-                def epoch(params, e):
-                    rng = jax.random.fold_in(
-                        jax.random.fold_in(key, e), widx)
-                    # Local copies must be explicitly worker-varying, else
-                    # the backward pass psums gradients globally (see
-                    # tree_pvary).
-                    local = tree_pvary(params)
-                    # Fresh worker optimizer each epoch, as the reference
-                    # recompiles the model per epoch (trainers.py:~170).
-                    opt_state = opt_init(local)
-                    (local, _, _), losses = jax.lax.scan(
-                        step, (local, opt_state, tree_pvary(rng)),
-                        (xs, ys))
-                    # pmean float weights; pmax integer leaves (lockstep
-                    # seed counters) back to an axis-invariant type for
-                    # the replicated epoch carry
-                    params = tree_pmean_sync(local)
-                    return params, losses
+                def one_step(carry, inp):
+                    params, local, opt_state, rng = carry
+                    t, x, y = inp
+                    e, si = t // spe, t % spe
+                    # epoch start: fresh local replica from the merged
+                    # params, fresh worker optimizer (the reference
+                    # recompiles per epoch, trainers.py:~170), fresh
+                    # per-epoch rng — all carried thereafter so chunk
+                    # boundaries at ANY step preserve the epoch math.
+                    # si is worker-UNIFORM (derived from the replicated
+                    # t), so lax.cond keeps the reset/merge work — incl.
+                    # the cross-worker pmean — off the per-step hot path
+                    # (a per-step where-form would all-reduce the full
+                    # parameter tree EVERY step).
+                    def reset(_):
+                        fresh = tree_pvary(jax.random.fold_in(
+                            jax.random.fold_in(key, e), widx))
+                        pv = tree_pvary(params)
+                        # pvary the fresh opt state too: its integer
+                        # count leaf inits invariant, but the carried
+                        # state is worker-sharded (varying) — cond
+                        # branches must agree
+                        return pv, tree_pvary(opt_init(pv)), fresh
 
-                params, losses = jax.lax.scan(
-                    epoch, params, jnp.arange(E) + epoch0)
-                return params, losses[None]  # losses: (1, E, steps)
+                    local, opt_state, rng = jax.lax.cond(
+                        si == 0, reset,
+                        lambda _: (local, opt_state, rng), None)
+                    (local, opt_state, rng), loss = step(
+                        (local, opt_state, rng), (x, y))
+                    # epoch end: pmean float weights; pmax integer
+                    # leaves (lockstep seed counters) back to an
+                    # axis-invariant type for the replicated carry
+                    params = jax.lax.cond(
+                        si == spe - 1,
+                        lambda l: tree_pmean_sync(l),
+                        lambda l: params, local)
+                    return (params, local, opt_state, rng), loss
+
+                if streamed:
+                    (params, local, opt_state, rng), losses = \
+                        jax.lax.scan(
+                            one_step, (params, local, opt_state, rng),
+                            (jnp.arange(T) + t0, xs, ys))
+                else:
+                    def indexed(c, t):
+                        si = t % spe
+                        x = jax.lax.dynamic_index_in_dim(
+                            xs, si, 0, keepdims=False)
+                        y = jax.lax.dynamic_index_in_dim(
+                            ys, si, 0, keepdims=False)
+                        return one_step(c, (t, x, y))
+
+                    (params, local, opt_state, rng), losses = \
+                        jax.lax.scan(
+                            indexed, (params, local, opt_state, rng),
+                            jnp.arange(T) + t0)
+                stack = lambda t_: t_[None]  # noqa: E731
+                return (params, jax.tree.map(stack, local),
+                        jax.tree.map(stack, opt_state), rng[None],
+                        losses[None])
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
-                out_specs=(P(), P(WORKER_AXIS)),
+                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                          P(), P()),
+                out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                           P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
         params = model.params
-        start_epoch, restored = self._maybe_resume({"params": params})
+        local = self._stack_workers(params)
+        opt_state = self._stack_workers(opt_init(params))
+        rng = self._stack_workers(jnp.zeros((2,), jnp.uint32))
+        template = {"params": params, "local": local,
+                    "opt_state": opt_state, "rng": rng}
+        start_t, restored = self._maybe_resume(
+            template,
+            incompatible_hint=(
+                "if this checkpoint predates step-granular "
+                "AveragingTrainer state (round 3: params only, step "
+                "counted epochs not steps), restart training or point "
+                "checkpoint_dir at a fresh directory"))
         if restored is not None:
+            if "local" not in restored:
+                # pickle-fallback checkpoints restore without a template
+                # match, so the orbax-path structure error can't fire
+                raise ValueError(
+                    "checkpoint predates step-granular AveragingTrainer "
+                    "state (params only; its step counts epochs, not "
+                    "steps) — restart training or point checkpoint_dir "
+                    "at a fresh directory")
             params = restored["params"]
+            local = restored["local"]
+            opt_state = restored["opt_state"]
+            rng = restored["rng"]
 
-        xs = self._to_device(xs)
-        ys = self._to_device(ys)
-        # data AND carry-state distribution completes OUTSIDE the clock
-        drain(xs, ys, params)
-        key = jax.random.PRNGKey(self.seed)
-        samples_per_epoch = xs.shape[0] * xs.shape[1] * self.batch_size
+        def dispatch(i, T, steps_done, data):
+            nonlocal params, local, opt_state, rng
+            streamed = self._streamed
+            fn = self._compiled(
+                lambda: build_chunk(T, streamed=streamed),
+                extra_key=("stream", T, spe) if streamed else (T, spe))
+            params, local, opt_state, rng, losses = fn(
+                params, local, opt_state, rng, *data, key,
+                jnp.int32(steps_done))
+            return losses
 
-        self.record_training_start()
-        all_losses = []
-        epochs_done = start_epoch
-        for E in self._chunk_plan(start_epoch):
-            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
-            t0 = _time.time()
-            params, losses = fn(params, xs, ys, key, jnp.int32(epochs_done))
-            drain(params)  # block_until_ready lies through the tunnel
-            dt = _time.time() - t0
-            epochs_done += E
-            losses = np.asarray(comm.fetch_global(losses))  # (workers, E, steps)
-            all_losses.append(losses)
-            self._emit_epoch_end(epochs_done, losses, dt,
-                                 samples_per_epoch * E)
-            self._maybe_checkpoint(epochs_done, lambda: {"params": params})
-        self.record_training_end()
-
-        history = (np.concatenate(all_losses, axis=1).tolist()
-                   if all_losses else [])
-        # history: per-worker per-epoch per-step losses
+        cadence = (self.checkpoint_every * spe
+                   if self.checkpoint_every else None)
+        history = run_chunked(
+            self, xs, ys, start=start_t, total=total_t, per_epoch=spe,
+            stream_units=self.stream_chunk_steps, cadence=cadence,
+            samples_per_unit=self.num_workers * self.batch_size,
+            dispatch=dispatch, sync_ref=lambda: params,
+            state_fn=lambda: {"params": params, "local": local,
+                              "opt_state": opt_state, "rng": rng},
+            carry_leaves=(params, local, opt_state, rng),
+            fetch_global=comm.fetch_global)
         return self._finalize(params, history)
 
 

@@ -28,6 +28,20 @@ import numpy as np
 from dist_keras_tpu.utils.sync import drain
 
 
+def init_streaming(trainer, chunk, budget, name="stream_chunk_windows"):
+    """Validate and install the streaming kwargs every streaming-capable
+    trainer shares (one definition instead of a per-class copy)."""
+    value = int(chunk) if chunk else None
+    if value is not None and value < 1:
+        raise ValueError(f"{name}={chunk} must be >= 1")
+    setattr(trainer, name, value)
+    trainer.max_resident_bytes = int(budget) if budget else None
+    if trainer.max_resident_bytes is not None \
+            and trainer.max_resident_bytes < 1:
+        raise ValueError(f"max_resident_bytes={budget} must be >= 1")
+    trainer._streamed = False  # set by train(); introspectable by tests
+
+
 def chunk_plan(start, total, per_epoch, *, epoch_bounds=False,
                cadence=None, data_chunk=None):
     """Chunk sizes (in scan units) for the dispatch loop.

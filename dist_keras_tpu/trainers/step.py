@@ -118,13 +118,3 @@ def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
         return tx.init(model.split_state(params)[0])
 
     return step, opt_init
-
-
-def scan_epoch(step, params, opt_state, rng, xb, yb):
-    """Run ``step`` over every batch with lax.scan.
-
-    xb/yb: (steps, batch, ...). Returns (params, opt_state, rng, losses).
-    """
-    (params, opt_state, rng), losses = jax.lax.scan(
-        step, (params, opt_state, rng), (xb, yb))
-    return params, opt_state, rng, losses

@@ -41,7 +41,7 @@ from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
-from dist_keras_tpu.trainers.chunking import run_chunked
+from dist_keras_tpu.trainers.chunking import init_streaming, run_chunked
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import (
     tree_add,
@@ -90,18 +90,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         # tensor would exceed B bytes of device memory, sizing C so two
         # in-flight chunks fit inside B.  Default (both None) keeps the
         # round-1 whole-run-resident fast path.
-        self.stream_chunk_windows = (int(stream_chunk_windows)
-                                     if stream_chunk_windows else None)
-        if self.stream_chunk_windows is not None \
-                and self.stream_chunk_windows < 1:
-            raise ValueError(
-                f"stream_chunk_windows={stream_chunk_windows} must be >= 1")
-        self.max_resident_bytes = (int(max_resident_bytes)
-                                   if max_resident_bytes else None)
-        if self.max_resident_bytes is not None and self.max_resident_bytes < 1:
-            raise ValueError(
-                f"max_resident_bytes={max_resident_bytes} must be >= 1")
-        self._streamed = False  # set by train(); introspectable by tests
+        init_streaming(self, stream_chunk_windows, max_resident_bytes)
 
     def _cache_extras(self):
         # the per-chunk epoch count is appended via _compiled(extra_key=)

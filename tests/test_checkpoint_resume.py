@@ -182,14 +182,17 @@ def test_resume_cadence_from_nonmultiple_epoch(tmp_path):
     t1 = dk.SingleTrainer(_model(), num_epoch=7, checkpoint_dir=ckdir,
                           checkpoint_every=3, max_checkpoints=10, **kw)
     t1.train(ds)
-    assert t1._checkpointer.all_steps() == [3, 6, 7]
+    # round 4: SingleTrainer's checkpoint counter is STEP-granular (like
+    # the windowed family's window counter) — epochs 3, 6, 7 in steps
+    spb = len(ds) // 16
+    assert t1._checkpointer.all_steps() == [3 * spb, 6 * spb, 7 * spb]
 
     t2 = dk.SingleTrainer(_model(), num_epoch=13, checkpoint_dir=ckdir,
                           checkpoint_every=3, max_checkpoints=10,
                           resume=True, **kw)
     t2.train(ds)
     # saves continue every 3 epochs from the resume point (7): 10, 13
-    assert t2._checkpointer.all_steps()[-2:] == [10, 13]
+    assert t2._checkpointer.all_steps()[-2:] == [10 * spb, 13 * spb]
 
 
 def test_checkpoint_every_requires_dir():

@@ -163,6 +163,76 @@ def test_stream_feed_closed_after_crash(blobs_dataset):
 
 
 # ---------------------------------------------------------------------------
+# SingleTrainer through the same machinery (flat-step chunking)
+# ---------------------------------------------------------------------------
+def test_single_trainer_stream_parity(blobs_dataset):
+    from dist_keras_tpu.trainers import SingleTrainer
+
+    def run(**kw):
+        t = SingleTrainer(_model(), worker_optimizer="sgd",
+                          optimizer_kwargs={"learning_rate": 0.05},
+                          batch_size=16, num_epoch=3,
+                          label_col="label_encoded", **kw)
+        return t, t.train(blobs_dataset)
+
+    t_res, m_res = run()
+    t_str, m_str = run(stream_chunk_steps=8)
+    assert not t_res._streamed and t_str._streamed
+    _params_equal(m_res, m_str)
+    np.testing.assert_array_equal(np.asarray(t_res.get_history()),
+                                  np.asarray(t_str.get_history()))
+    assert t_str._last_feed.peak_resident_chunks <= 2
+
+    t_auto, m_auto = run(max_resident_bytes=4096)
+    assert t_auto._streamed
+    _params_equal(m_res, m_auto)
+
+
+def test_single_trainer_stream_resume(tmp_path, blobs_dataset):
+    from dist_keras_tpu.trainers import SingleTrainer
+
+    ck = str(tmp_path / "ck")
+    kw = dict(worker_optimizer="sgd",
+              optimizer_kwargs={"learning_rate": 0.05}, batch_size=16,
+              num_epoch=4, label_col="label_encoded",
+              stream_chunk_steps=8)
+    t_full = SingleTrainer(_model(), **kw)
+    m_full = t_full.train(blobs_dataset)
+
+    t1 = SingleTrainer(_model(), checkpoint_dir=ck, checkpoint_every=2,
+                       **kw)
+    t1.num_epoch = 2  # stop half way
+    t1.train(blobs_dataset)
+    t2 = SingleTrainer(_model(), checkpoint_dir=ck, checkpoint_every=2,
+                       resume=True, **kw)
+    m_resumed = t2.train(blobs_dataset)
+    _params_equal(m_full, m_resumed)
+
+
+# ---------------------------------------------------------------------------
+# AveragingTrainer through the same machinery
+# ---------------------------------------------------------------------------
+def test_averaging_stream_parity(blobs_dataset):
+    from dist_keras_tpu.trainers import AveragingTrainer
+
+    def run(**kw):
+        t = AveragingTrainer(_model(), num_workers=4,
+                             worker_optimizer="sgd",
+                             optimizer_kwargs={"learning_rate": 0.05},
+                             batch_size=8, num_epoch=3,
+                             label_col="label_encoded", **kw)
+        return t, t.train(blobs_dataset)
+
+    t_res, m_res = run()
+    t_str, m_str = run(stream_chunk_steps=6)  # cuts mid-epoch (spe=16)
+    assert not t_res._streamed and t_str._streamed
+    _params_equal(m_res, m_str)
+    np.testing.assert_array_equal(np.asarray(t_res.get_history()),
+                                  np.asarray(t_str.get_history()))
+    assert t_str._last_feed.peak_resident_chunks <= 2
+
+
+# ---------------------------------------------------------------------------
 # DynSGD through the same machinery (step-granular chunking)
 # ---------------------------------------------------------------------------
 def test_dynsgd_stream_parity(blobs_dataset):
