@@ -336,3 +336,54 @@ def test_two_process_pp_matches_single_process(tmp_path):
         mesh, cfg, x, y, num_microbatches=4, steps=3,
         optimizer=optax.adam(1e-2), causal=True, seed=0)
     _assert_same(w0, w1, jax.tree.leaves((rest, blocks)))
+
+
+def _averaging_body():
+    return r"""
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.trainers import AveragingTrainer
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 8)).astype(np.float32)
+yv = rng.integers(0, 2, 256)
+ds = Dataset({"features": x, "label": yv, "label_encoded": one_hot(yv, 2)})
+t = AveragingTrainer(mnist_mlp(hidden=(8,), input_dim=8, num_classes=2,
+                               seed=0),
+                     num_workers=8, worker_optimizer="sgd",
+                     optimizer_kwargs={"learning_rate": 0.05},
+                     batch_size=8, num_epoch=2,
+                     label_col="label_encoded", seed=0)
+m = t.train(ds)
+mesh = t.mesh
+import jax
+leaves = jax.tree.leaves(m.params)
+"""
+
+
+def test_two_process_averaging_matches_single_process(tmp_path):
+    """The round-4 flat-step AveragingTrainer (epoch merges under
+    lax.cond) on a worker mesh spanning 2 hosts."""
+    w0, w1 = _run_pair(tmp_path, _averaging_body())
+
+    import jax
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.trainers import AveragingTrainer
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    yv = rng.integers(0, 2, 256)
+    ds = Dataset({"features": x, "label": yv,
+                  "label_encoded": one_hot(yv, 2)})
+    t = AveragingTrainer(mnist_mlp(hidden=(8,), input_dim=8,
+                                   num_classes=2, seed=0),
+                         num_workers=8, worker_optimizer="sgd",
+                         optimizer_kwargs={"learning_rate": 0.05},
+                         batch_size=8, num_epoch=2,
+                         label_col="label_encoded", seed=0)
+    m = t.train(ds)
+    _assert_same(w0, w1, jax.tree.leaves(m.params))
