@@ -1,3 +1,4 @@
+from dist_keras_tpu.launch.config import JobConfig
 from dist_keras_tpu.launch.job import Job, Punchcard
 
-__all__ = ["Job", "Punchcard"]
+__all__ = ["Job", "JobConfig", "Punchcard"]

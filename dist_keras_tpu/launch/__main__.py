@@ -1,0 +1,85 @@
+"""CLI for the launcher — ``python -m dist_keras_tpu.launch``.
+
+Two modes (SURVEY.md §5: "a thin dataclass config + optional CLI for the
+launcher"; the reference's job_deployment.py has no CLI — jobs are
+launched from notebook code — so this is the one place the TPU build
+adds shell surface):
+
+  # ship + start one job described by a JobConfig JSON
+  python -m dist_keras_tpu.launch --job job.json [--dry-run]
+
+  # poll a Punchcard manifest of secret-authenticated jobs
+  python -m dist_keras_tpu.launch --manifest punchcard.json \
+      --secret S [--secret S2 ...] [--poll-interval 5] [--max-polls N] \
+      [--dry-run]
+
+``--dry-run`` prints every rsync/ssh command instead of executing it —
+the same mechanism the unit tests use (tests/test_aux.py), so a config
+can be validated end-to-end without a cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from dist_keras_tpu.launch.config import JobConfig
+from dist_keras_tpu.launch.job import Punchcard
+
+
+def _print_commands(job):
+    for cmd in job.commands:
+        print("DRY-RUN " + " ".join(shlex.quote(c) for c in cmd))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m dist_keras_tpu.launch",
+        description="Deploy dist_keras_tpu training jobs to TPU-pod "
+                    "hosts (rsync + ssh + jax.distributed env).")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--job", help="path to a JobConfig JSON")
+    mode.add_argument("--manifest",
+                      help="path to a Punchcard manifest JSON (list of "
+                           "job descriptors with 'secret' fields)")
+    ap.add_argument("--secret", action="append", default=[],
+                    help="authorized secret (repeatable; manifest mode)")
+    ap.add_argument("--poll-interval", type=float, default=5.0)
+    ap.add_argument("--max-polls", type=int, default=None,
+                    help="stop after N polls (default: poll forever)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the rsync/ssh commands, execute nothing")
+    args = ap.parse_args(argv)
+
+    if args.job:
+        cfg = JobConfig.from_json(args.job)
+        job = cfg.to_job(dry_run=args.dry_run)
+        rc = job.send()
+        if args.dry_run:
+            _print_commands(job)
+        return rc
+
+    if not args.secret:
+        ap.error("--manifest mode needs at least one --secret")
+    pc = Punchcard(args.manifest, secrets=args.secret,
+                   poll_interval=args.poll_interval,
+                   dry_run=args.dry_run)
+    if args.max_polls is None and args.dry_run:
+        args.max_polls = 1  # a dry-run that polls forever helps no one
+    ran = pc.run(max_polls=args.max_polls)
+    if args.dry_run:
+        for job in ran:
+            _print_commands(job)
+    # mirror --job mode: a failed deployment is a failed invocation.
+    # Judge each job by its FINAL attempt (an early failure retried to
+    # success across polls is a success), and fold signal-killed rcs
+    # (negative from subprocess.call) into plain failure
+    final = {}
+    for job in ran:
+        final[job.job_name] = job.last_rc
+    return 0 if all(rc == 0 for rc in final.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

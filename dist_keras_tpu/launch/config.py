@@ -1,0 +1,90 @@
+"""Thin dataclass config for the launcher (SURVEY.md §5 "Config" row).
+
+The reference configures ``Job``/``Punchcard`` purely through constructor
+kwargs (job_deployment.py:~30,~150) and the rest of dist-keras through
+trainer kwargs — there is no flag system to mirror.  What SURVEY owes on
+top of kwargs-parity is exactly this: a declarative config a shell can
+drive, so a cluster operator can keep job descriptors in versioned JSON
+instead of Python.  ``JobConfig`` is that descriptor; the CLI
+(``python -m dist_keras_tpu.launch``) loads one — or a Punchcard manifest
+of many — and drives the existing ``Job``/``Punchcard`` layer unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+
+from dist_keras_tpu.launch.job import Job
+
+
+@dataclass
+class JobConfig:
+    """Declarative form of ``Job``'s constructor (launch/job.py:45).
+
+    Field names match the constructor kwargs one-to-one so a config dict
+    is also a valid Punchcard manifest entry (minus ``dry_run``, which is
+    an execution-time choice, not part of the job's identity).
+    """
+
+    job_name: str
+    job_dir: str
+    secret: str = ""
+    entrypoint: str = "main.py"
+    hosts: list = field(default_factory=list)
+    coordinator_port: int = 8476
+    num_processes: int | None = None
+    remote_root: str = "~/jobs"
+    python: str = "python3"
+
+    # operator-facing JSON surface: validate types, not just names — a
+    # string where a list belongs (hosts: "localhost") would otherwise
+    # fan out to one ssh target per CHARACTER via list("localhost")
+    _TYPES = {"job_name": str, "job_dir": str, "secret": str,
+              "entrypoint": str, "hosts": (list, tuple),
+              "coordinator_port": int, "num_processes": (int, type(None)),
+              "remote_root": str, "python": str}
+
+    @classmethod
+    def from_dict(cls, d):
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown JobConfig field(s) {sorted(unknown)}; "
+                f"valid fields: {sorted(known)}")
+        missing = {f.name for f in dataclasses.fields(cls)
+                   if f.default is dataclasses.MISSING
+                   and f.default_factory is dataclasses.MISSING} - set(d)
+        if missing:
+            raise ValueError(f"JobConfig missing required field(s) "
+                             f"{sorted(missing)}")
+        for name, value in d.items():
+            want = cls._TYPES[name]
+            if not isinstance(value, want) or isinstance(value, bool):
+                names = " | ".join(
+                    t.__name__ for t in
+                    (want if isinstance(want, tuple) else (want,)))
+                raise ValueError(
+                    f"JobConfig field {name!r} expects {names}, got "
+                    f"{type(value).__name__}: {value!r}")
+        if "hosts" in d and not all(isinstance(h, str)
+                                    for h in d["hosts"]):
+            raise ValueError("JobConfig field 'hosts' must be a list "
+                             "of strings")
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, path):
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def to_job(self, dry_run=False):
+        """Instantiate the imperative ``Job`` (which re-validates every
+        shell-reaching field — names, hosts, remote_root)."""
+        kw = self.to_dict()
+        return Job(dry_run=dry_run, **kw)

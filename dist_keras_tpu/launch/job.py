@@ -163,7 +163,10 @@ class Punchcard:
         return jobs
 
     def run_once(self):
-        """Authenticate + run every pending job once; returns the jobs."""
+        """Authenticate + run every pending job once; returns the jobs
+        (each with ``last_rc`` set).  A job is only marked executed when
+        its deployment succeeded — a failed rsync/ssh is retried on the
+        next poll instead of being silently swallowed."""
         ran = []
         for spec in self.pending_jobs():
             spec = dict(spec)
@@ -171,16 +174,25 @@ class Punchcard:
             if name in self.executed:
                 continue
             job = Job(dry_run=self.dry_run, **spec)
-            job.send()
-            self.executed.append(name)
+            job.last_rc = job.send()
+            if job.last_rc == 0:
+                self.executed.append(name)
             ran.append(job)
         return ran
 
     def run(self, max_polls=None):
-        """Poll loop (the reference's Punchcard.run)."""
+        """Poll loop (the reference's Punchcard.run).  With a finite
+        ``max_polls``, returns every Job instance launched across the
+        polls; the poll-forever daemon path keeps nothing (a retrying
+        job would otherwise grow an unbounded Job list, and the return
+        is unreachable anyway)."""
         polls = 0
+        ran = [] if max_polls is not None else None
         while max_polls is None or polls < max_polls:
-            self.run_once()
+            launched = self.run_once()
+            if ran is not None:
+                ran.extend(launched)
             polls += 1
             if max_polls is None or polls < max_polls:
                 time.sleep(self.poll_interval)
+        return ran or []

@@ -57,9 +57,14 @@ def _grow_carry_vma(step_carry, carry0):
     """Promote each carry leaf's varying-axes (vma) set to the fixed
     point implied by one application of the scan body — so the carry
     type is stable under shard_map's check_vma on ANY mesh the caller
-    composed around the pipe axis.  vma sets only grow, so the loop
-    terminates in at most #axes rounds."""
-    for _ in range(4):
+    composed around the pipe axis.  vma sets only grow and are bounded
+    by the mesh's axis names, so the fixed point arrives in at most
+    #axes+1 rounds; a mesh with more axes than the round bound gets a
+    clear error instead of shard_map's opaque vma mismatch."""
+    # bound = #axes + 1 (one confirming round past the last widening);
+    # 10 covers meshes up to rank 9, far past any practical composition
+    max_rounds = 10
+    for _ in range(max_rounds):
         out = jax.eval_shape(step_carry, carry0)
         changed = False
 
@@ -75,8 +80,11 @@ def _grow_carry_vma(step_carry, carry0):
 
         carry0 = jax.tree.map(widen, carry0, out)
         if not changed:
-            break
-    return carry0
+            return carry0
+    raise ValueError(
+        f"pipeline scan carry varying-axes sets did not reach a fixed "
+        f"point within {max_rounds} widening rounds — mesh has more "
+        f"axes than the bound; raise max_rounds in _grow_carry_vma")
 
 
 def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,

@@ -21,7 +21,11 @@ from dist_keras_tpu.parallel.collectives import tree_pmean_sync, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
-from dist_keras_tpu.trainers.chunking import run_chunked
+from dist_keras_tpu.trainers.chunking import (
+    reject_stale_checkpoint,
+    run_chunked,
+    scan_units,
+)
 from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.sync import drain
 
@@ -107,24 +111,9 @@ class AveragingTrainer(DistributedTrainer):
                         lambda l: params, local)
                     return (params, local, opt_state, rng), loss
 
-                if streamed:
-                    (params, local, opt_state, rng), losses = \
-                        jax.lax.scan(
-                            one_step, (params, local, opt_state, rng),
-                            (jnp.arange(T) + t0, xs, ys))
-                else:
-                    def indexed(c, t):
-                        si = t % spe
-                        x = jax.lax.dynamic_index_in_dim(
-                            xs, si, 0, keepdims=False)
-                        y = jax.lax.dynamic_index_in_dim(
-                            ys, si, 0, keepdims=False)
-                        return one_step(c, (t, x, y))
-
-                    (params, local, opt_state, rng), losses = \
-                        jax.lax.scan(
-                            indexed, (params, local, opt_state, rng),
-                            jnp.arange(T) + t0)
+                (params, local, opt_state, rng), losses = scan_units(
+                    one_step, (params, local, opt_state, rng),
+                    xs, ys, T, t0, spe, streamed)
                 stack = lambda t_: t_[None]  # noqa: E731
                 return (params, jax.tree.map(stack, local),
                         jax.tree.map(stack, opt_state), rng[None],
@@ -152,15 +141,10 @@ class AveragingTrainer(DistributedTrainer):
                 "AveragingTrainer state (round 3: params only, step "
                 "counted epochs not steps), restart training or point "
                 "checkpoint_dir at a fresh directory"))
+        reject_stale_checkpoint(
+            restored, "local", "AveragingTrainer",
+            "params only; its step counts epochs, not steps")
         if restored is not None:
-            if "local" not in restored:
-                # pickle-fallback checkpoints restore without a template
-                # match, so the orbax-path structure error can't fire
-                raise ValueError(
-                    "checkpoint predates step-granular AveragingTrainer "
-                    "state (params only; its step counts epochs, not "
-                    "steps) — restart training or point checkpoint_dir "
-                    "at a fresh directory")
             params = restored["params"]
             local = restored["local"]
             opt_state = restored["opt_state"]
@@ -198,10 +182,24 @@ class EnsembleTrainer(DistributedTrainer):
     ``num_models`` may exceed the device count (the reference trains any
     N over however many executors Spark has): models are laid out
     ``(mesh slots, models_per_slot)`` and each slot ``vmap``s its
-    replicas — one compiled program regardless of the ratio."""
+    replicas — one compiled program regardless of the ratio.
 
-    def __init__(self, keras_model, num_models=2, **kw):
+    Round 5: the run is a flat scan over GLOBAL steps through the shared
+    ``ChunkRunner`` — each step ``vmap``s the model step across the
+    slot's replicas and the per-model per-epoch rng is re-derived at
+    each epoch's first step (identical math to the round-4 nested
+    epoch scan), which buys the ensemble the same streaming feed as
+    every other trainer (``stream_chunk_steps`` counts chunks in STEPS;
+    ``max_resident_bytes`` auto-switches): the last resident-only
+    trainer is gone — an ensemble whose data exceeds HBM streams
+    through the two-buffer ChunkFeed like the rest of the family
+    (reference property: an epoch never has to fit in executor memory,
+    workers.py:~60)."""
+
+    def __init__(self, keras_model, num_models=2, stream_chunk_steps=None,
+                 max_resident_bytes=None, **kw):
         from dist_keras_tpu.parallel.mesh import num_available_devices
+        from dist_keras_tpu.trainers.chunking import init_streaming
 
         self.num_models = int(num_models)
         slots = kw.pop("num_workers", None)
@@ -217,6 +215,8 @@ class EnsembleTrainer(DistributedTrainer):
                 "num_workers=<divisor>)")
         super().__init__(keras_model, num_workers=slots, **kw)
         self.models_per_slot = self.num_models // slots
+        init_streaming(self, stream_chunk_steps, max_resident_bytes,
+                       name="stream_chunk_steps")
 
     def _cache_extras(self):
         # slots alone no longer distinguishes configs: equal slot counts
@@ -224,15 +224,15 @@ class EnsembleTrainer(DistributedTrainer):
         return super()._cache_extras() + (self.num_models,)
 
     def train(self, dataset, shuffle=False):
-        import time as _time
-
         model, loss_fn, tx = self._resolve()
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         # one data shard per MODEL (reference: one partition per model);
-        # leading axis regrouped (slots, models_per_slot, steps, ...).
-        # Multi-host: host h owns mesh slots [lo, hi), hence global model
-        # ids [lo*mps, hi*mps) — slice exactly those models' rows so the
+        # leading axis regrouped (slots, steps, models_per_slot, ...) —
+        # steps on axis 1 so the ChunkFeed's axis-1 spans slice the scan
+        # axis while mps rides inside each chunk's put.  Multi-host:
+        # host h owns mesh slots [lo, hi), hence global model ids
+        # [lo*mps, hi*mps) — slice exactly those models' rows so the
         # concatenation over hosts equals the single-host deal.
         mps = self.models_per_slot
         mesh = self.mesh  # prime the mesh (and multi-host bring-up)
@@ -245,91 +245,129 @@ class EnsembleTrainer(DistributedTrainer):
             self.num_models, self.batch_size,
             features_col=self.features_col, label_col=self.label_col,
             worker_range=model_range, dtype=self.data_dtype)
-        # -1, not self.num_workers: on multi-host only this host's
-        # models are materialized, so the leading dim is the LOCAL count
-        xs = xs.reshape(-1, mps, *xs.shape[1:])
-        ys = ys.reshape(-1, mps, *ys.shape[1:])
+
+        def _regroup(a):
+            # -1, not self.num_workers: on multi-host only this host's
+            # models are materialized (leading dim = LOCAL slot count)
+            a = a.reshape(-1, mps, *a.shape[1:])
+            return np.ascontiguousarray(
+                a.transpose(0, 2, 1, *range(3, a.ndim)))
+
+        xs, ys = _regroup(xs), _regroup(ys)  # (slots, steps, mps, ...)
+        spe = xs.shape[1]
+        total_t = self.num_epoch * spe
         step, opt_init = make_model_step(
             model, loss_fn, tx, self.compute_dtype)
+        key = jax.random.PRNGKey(self.seed)
 
-        def build_chunk(E):
-            def body(params, opt_state, xs, ys, key, epoch0):
+        def build_chunk(T, streamed=False):
+            def body(params, opt_state, rng, xs, ys, key, t0):
                 # carry arrives stacked (1, mps, ...) per slot
                 xs, ys = xs[0], ys[0]
                 params = jax.tree.map(lambda t: t[0], params)
                 opt_state = jax.tree.map(lambda t: t[0], opt_state)
+                rng = rng[0]
                 slot = jax.lax.axis_index(WORKER_AXIS)
                 midx = slot * mps + jnp.arange(mps)  # global model ids
 
-                def per_model(p, o, x, y, mi):
-                    rng = jax.random.fold_in(key, mi)
+                def one_step(carry, inp):
+                    params, opt_state, rng = carry
+                    t, x, y = inp  # x, y: (mps, batch, ...)
+                    e, si = t // spe, t % spe
 
-                    def epoch(carry, e):
-                        p, o = carry
-                        erng = tree_pvary(jax.random.fold_in(rng, e))
-                        (p, o, _), losses = jax.lax.scan(
-                            step, (p, o, erng), (x, y))
-                        return (p, o), losses
+                    # epoch start: fresh per-model per-epoch rng —
+                    # identical derivation to the round-4 nested epoch
+                    # scan (fold_in(fold_in(key, model_id), epoch)), so
+                    # chunk boundaries at ANY step preserve the epoch
+                    # math.  si is worker-UNIFORM (derived from the
+                    # replicated t): lax.cond keeps the re-derivation
+                    # off the per-step hot path.
+                    def reset(_):
+                        return jax.vmap(lambda mi: tree_pvary(
+                            jax.random.fold_in(
+                                jax.random.fold_in(key, mi), e)))(midx)
 
-                    (p, o), losses = jax.lax.scan(
-                        epoch, (p, o), jnp.arange(E) + epoch0)
-                    return p, o, losses
+                    rng = jax.lax.cond(si == 0, reset,
+                                       lambda _: rng, None)
 
-                params, opt_state, losses = jax.vmap(per_model)(
-                    params, opt_state, xs, ys, midx)
-                stack = lambda t: t[None]  # noqa: E731
+                    def per_model(p, o, r, xm, ym):
+                        (p, o, r), loss = step((p, o, r), (xm, ym))
+                        return p, o, r, loss
+
+                    params, opt_state, rng, loss = jax.vmap(per_model)(
+                        params, opt_state, rng, x, y)
+                    return (params, opt_state, rng), loss
+
+                (params, opt_state, rng), losses = scan_units(
+                    one_step, (params, opt_state, rng),
+                    xs, ys, T, t0, spe, streamed)
+                stack = lambda t_: t_[None]  # noqa: E731
+                # losses: (T, mps) -> (1, T, mps): run_chunked's unit
+                # axis is 1, models ride behind it
                 return (jax.tree.map(stack, params),
-                        jax.tree.map(stack, opt_state), losses[None])
+                        jax.tree.map(stack, opt_state), rng[None],
+                        losses[None])
 
             return jax.jit(shard_map(
                 body, mesh=mesh,
                 in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
-                          P(WORKER_AXIS), P(), P()),
-                out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+                          P(WORKER_AXIS), P(WORKER_AXIS), P(), P()),
+                out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                           P(WORKER_AXIS)),
             ))
 
         stacked = self._stack_workers(model.params, inner=(mps,))
         opt_state = self._stack_workers(opt_init(model.params),
                                         inner=(mps,))
-        start_epoch, restored = self._maybe_resume(
-            {"params": stacked, "opt_state": opt_state})
+        rng = self._stack_workers(jnp.zeros((2,), jnp.uint32),
+                                  inner=(mps,))
+        template = {"params": stacked, "opt_state": opt_state, "rng": rng}
+        hint = ("if this checkpoint predates step-granular "
+                "EnsembleTrainer state (round 4: no rng leaf, step "
+                "counted epochs not steps), restart training or point "
+                "checkpoint_dir at a fresh directory")
+        start_t, restored = self._maybe_resume(
+            template, incompatible_hint=hint)
+        reject_stale_checkpoint(
+            restored, "rng", "EnsembleTrainer",
+            "no rng leaf; its step counts epochs, not steps")
         if restored is not None:
             stacked = restored["params"]
             opt_state = restored["opt_state"]
+            rng = restored["rng"]
 
-        xs = self._to_device(xs)
-        ys = self._to_device(ys)
-        # data AND carry-state distribution completes OUTSIDE the clock
-        drain(xs, ys, stacked, opt_state)
-        key = jax.random.PRNGKey(self.seed)
-        # xs: (slots, mps, steps, batch, ...)
-        samples_per_epoch = (xs.shape[0] * xs.shape[1] * xs.shape[2]
-                             * self.batch_size)
+        def dispatch(i, T, steps_done, data):
+            nonlocal stacked, opt_state, rng
+            streamed = self._streamed
+            fn = self._compiled(
+                lambda: build_chunk(T, streamed=streamed),
+                extra_key=("stream", T, spe) if streamed else (T, spe))
+            stacked, opt_state, rng, losses = fn(
+                stacked, opt_state, rng, *data, key,
+                jnp.int32(steps_done))
+            return losses
 
-        self.record_training_start()
-        all_losses = []
-        epochs_done = start_epoch
-        for E in self._chunk_plan(start_epoch):
-            fn = self._compiled(lambda: build_chunk(E), extra_key=(E,))
-            t0 = _time.time()
-            stacked, opt_state, losses = fn(
-                stacked, opt_state, xs, ys, key, jnp.int32(epochs_done))
-            drain(stacked)  # block_until_ready lies through the tunnel
-            dt = _time.time() - t0
-            epochs_done += E
-            # (slots, mps, E, steps) -> (num_models, E, steps)
-            losses = np.asarray(comm.fetch_global(losses))
-            losses = losses.reshape(self.num_models, *losses.shape[2:])
-            all_losses.append(losses)
-            self._emit_epoch_end(epochs_done, losses, dt,
-                                 samples_per_epoch * E)
-            self._maybe_checkpoint(
-                epochs_done,
-                lambda: {"params": stacked, "opt_state": opt_state})
-        self.record_training_end()
-
-        self.history = (np.concatenate(all_losses, axis=1).tolist()
-                        if all_losses else [])
+        cadence = (self.checkpoint_every * spe
+                   if self.checkpoint_every else None)
+        hist = run_chunked(
+            self, xs, ys, start=start_t, total=total_t, per_epoch=spe,
+            stream_units=self.stream_chunk_steps, cadence=cadence,
+            samples_per_unit=self.num_models * self.batch_size,
+            dispatch=dispatch, sync_ref=lambda: stacked,
+            state_fn=lambda: {"params": stacked, "opt_state": opt_state,
+                              "rng": rng},
+            carry_leaves=(stacked, opt_state, rng),
+            fetch_global=comm.fetch_global)
+        # (slots, epochs, spe, mps) -> (num_models, epochs, spe); a
+        # mid-epoch resume's partial run stays flat (slots, T, mps) ->
+        # (num_models, T), mirroring the windowed family's convention
+        arr = np.asarray(hist)
+        if arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2).reshape(
+                self.num_models, arr.shape[1], arr.shape[2])
+        elif arr.ndim == 3:
+            arr = arr.transpose(0, 2, 1).reshape(self.num_models, -1)
+        self.history = arr.tolist()
 
         # one device->host transfer for the whole ensemble, then slice
         # (fetch_global: multi-host gathers every host's slots so ALL

@@ -161,7 +161,17 @@ class Trainer:
         refs, pins = Trainer._id_pin_refs, Trainer._id_pins
         fn = cache.pop(key, None)
         if fn is None:
-            fn = builder()
+            try:
+                fn = builder()
+            except Exception:
+                # _cache_key's _tok pinned the key's objects into
+                # _id_pins before the lookup; a failed build never gets
+                # a refcount, so drop any pin no live key refcounts or
+                # it leaks for the process lifetime
+                for i in Trainer._key_obj_ids(key):
+                    if i not in refs:
+                        pins.pop(i, None)
+                raise
             for i in Trainer._key_obj_ids(key):  # new key: pin its objs
                 refs[i] = refs.get(i, 0) + 1
             while len(cache) >= Trainer._jit_cache_max:

@@ -62,7 +62,7 @@ def main():
     ap.add_argument("--fast", action="store_true",
                     help="CI tier (minutes) instead of the full tier")
     ap.add_argument("--round", type=int,
-                    default=int(os.environ.get("GRAFT_ROUND", 4)))
+                    default=int(os.environ.get("GRAFT_ROUND", 5)))
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -77,9 +77,13 @@ def main():
         "pytest_exit_code": res["exit_code"],
         "seconds": res["seconds"],
         "environment": {
-            "harness": "8-virtual-device CPU mesh (tests/conftest.py); "
-                       "multi-worker gates need a worker mesh a single "
-                       "TPU chip cannot host",
+            "harness": "8-virtual-device CPU mesh (tests/conftest.py) "
+                       "for multi-worker gates (a single TPU chip cannot "
+                       "host a worker mesh); 1-worker gates additionally "
+                       "run on the real chip — see each gate record's "
+                       "'platform' field (round 5: single_mnist_mlp_tpu)",
+            "platforms": sorted({g.get("platform", "cpu")
+                                 for g in res["gates"]}),
             "python": platform.python_version(),
             "machine": platform.machine(),
         },

@@ -8,6 +8,12 @@ jax import.
 
 import os
 
+# remember the host's real platform (the image presets JAX_PLATFORMS=axon
+# -> 1 real TPU chip) BEFORE pinning the suite to CPU: the TPU-tier gate
+# (test_examples.test_single_mnist_mlp_tpu) restores it in a subprocess
+# so at least one accuracy gate executes on actual hardware
+os.environ.setdefault("DK_HOST_JAX_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -174,3 +174,97 @@ def test_job_rejects_unsafe_names(tmp_path):
     job = Job("s", "ok-name_1", str(tmp_path), hosts=["h"], dry_run=True)
     job.send()
     assert any("rsync" == c[0] for c in job.commands)
+
+
+# ------------------------------------------------- launch config + CLI
+def _write_jobdir(tmp_path):
+    jobdir = tmp_path / "job"
+    jobdir.mkdir(exist_ok=True)
+    (jobdir / "main.py").write_text("print('hi')")
+    return jobdir
+
+
+def test_job_config_round_trip_and_validation(tmp_path):
+    from dist_keras_tpu.launch import JobConfig
+
+    jobdir = _write_jobdir(tmp_path)
+    cfg = JobConfig.from_dict({"job_name": "exp1", "job_dir": str(jobdir),
+                               "hosts": ["h0", "h1"]})
+    assert cfg.coordinator_port == 8476  # defaults fill in
+    job = cfg.to_job(dry_run=True)
+    assert job.send() == 0
+    assert sum(c[0] == "rsync" for c in job.commands) == 2
+    # unknown and missing fields are named in the error
+    with pytest.raises(ValueError, match="unknown JobConfig field"):
+        JobConfig.from_dict({"job_name": "a", "job_dir": ".",
+                             "hostz": ["h"]})
+    with pytest.raises(ValueError, match="missing required"):
+        JobConfig.from_dict({"job_name": "a"})
+    # a JSON string where the hosts list belongs must not fan out to
+    # one ssh target per character
+    with pytest.raises(ValueError, match="hosts"):
+        JobConfig.from_dict({"job_name": "a", "job_dir": ".",
+                             "hosts": "localhost"})
+    with pytest.raises(ValueError, match="coordinator_port"):
+        JobConfig.from_dict({"job_name": "a", "job_dir": ".",
+                             "hosts": ["h"], "coordinator_port": "8476"})
+    # config -> dict -> manifest entry round trip keeps Job kwargs valid
+    d = cfg.to_dict()
+    assert JobConfig.from_dict(d) == cfg
+
+
+def test_launch_cli_job_dry_run(tmp_path, capsys):
+    from dist_keras_tpu.launch.__main__ import main
+
+    jobdir = _write_jobdir(tmp_path)
+    cfg_path = tmp_path / "job.json"
+    cfg_path.write_text(json.dumps(
+        {"job_name": "exp1", "job_dir": str(jobdir), "secret": "s",
+         "hosts": ["tpu-host-0", "tpu-host-1"]}))
+    rc = main(["--job", str(cfg_path), "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("DRY-RUN ")]
+    assert sum("rsync" in ln for ln in lines) == 2
+    assert sum("ssh" in ln for ln in lines) == 2
+    assert any("JAX_PROCESS_ID=1" in ln for ln in lines)
+
+
+def test_launch_cli_manifest_dry_run(tmp_path, capsys):
+    from dist_keras_tpu.launch.__main__ import main
+
+    jobdir = _write_jobdir(tmp_path)
+    manifest = [
+        {"secret": "good", "job_name": "a", "job_dir": str(jobdir),
+         "hosts": ["h0"]},
+        {"secret": "evil", "job_name": "b", "job_dir": str(jobdir),
+         "hosts": ["h0"]},
+    ]
+    mpath = tmp_path / "manifest.json"
+    mpath.write_text(json.dumps(manifest))
+    rc = main(["--manifest", str(mpath), "--secret", "good", "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # only the authenticated job ran; dry-run capped itself at one poll
+    assert "/a/" in out and "/b/" not in out
+
+
+def test_launch_cli_module_entry(tmp_path):
+    """`python -m dist_keras_tpu.launch` is a real shell entrypoint."""
+    import subprocess
+    import sys
+
+    jobdir = _write_jobdir(tmp_path)
+    cfg_path = tmp_path / "job.json"
+    cfg_path.write_text(json.dumps(
+        {"job_name": "exp1", "job_dir": str(jobdir), "hosts": ["h0"]}))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dist_keras_tpu.launch",
+         "--job", str(cfg_path), "--dry-run"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRY-RUN rsync" in proc.stdout
+    assert "DRY-RUN ssh" in proc.stdout

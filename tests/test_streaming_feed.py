@@ -134,6 +134,11 @@ def test_invalid_stream_params_raise():
         ADAG(_model(), stream_chunk_windows=-2)
     with pytest.raises(ValueError, match="max_resident_bytes"):
         ADAG(_model(), max_resident_bytes=-1)
+    # 0 raises too (round-5 advisor fix: it used to silently mean "off")
+    with pytest.raises(ValueError, match="stream_chunk_windows"):
+        ADAG(_model(), stream_chunk_windows=0)
+    with pytest.raises(ValueError, match="max_resident_bytes"):
+        ADAG(_model(), max_resident_bytes=0)
 
 
 def test_stream_resume_of_finished_run(tmp_path, blobs_dataset):
@@ -230,6 +235,50 @@ def test_averaging_stream_parity(blobs_dataset):
     np.testing.assert_array_equal(np.asarray(t_res.get_history()),
                                   np.asarray(t_str.get_history()))
     assert t_str._last_feed.peak_resident_chunks <= 2
+
+
+# ---------------------------------------------------------------------------
+# EnsembleTrainer through the same machinery (round 5: the last
+# resident-only trainer joins the feed; steps slice on axis 1 with the
+# models-per-slot replicas riding inside each chunk's put)
+# ---------------------------------------------------------------------------
+def test_ensemble_stream_parity(blobs_dataset):
+    from dist_keras_tpu.trainers import EnsembleTrainer
+
+    def run(**kw):
+        t = EnsembleTrainer(_model(), num_models=8, num_workers=4,
+                            worker_optimizer="sgd",
+                            optimizer_kwargs={"learning_rate": 0.05},
+                            batch_size=8, num_epoch=3,
+                            label_col="label_encoded", **kw)
+        return t, t.train(blobs_dataset)
+
+    t_res, ms_res = run()
+    t_str, ms_str = run(stream_chunk_steps=3)  # cuts mid-epoch (spe=8)
+    assert not t_res._streamed and t_str._streamed
+    assert len(ms_res) == len(ms_str) == 8
+    for m_res, m_str in zip(ms_res, ms_str):
+        _params_equal(m_res, m_str)
+    np.testing.assert_array_equal(np.asarray(t_res.get_history()),
+                                  np.asarray(t_str.get_history()))
+    feed = t_str._last_feed
+    assert feed.peak_resident_chunks <= 2
+    assert feed.put_count == len(feed)
+
+
+def test_ensemble_auto_stream_on_budget(blobs_dataset):
+    from dist_keras_tpu.trainers import EnsembleTrainer
+
+    t = EnsembleTrainer(_model(), num_models=8, num_workers=4,
+                        worker_optimizer="sgd",
+                        optimizer_kwargs={"learning_rate": 0.05},
+                        batch_size=8, num_epoch=2,
+                        label_col="label_encoded",
+                        max_resident_bytes=2048)
+    models = t.train(blobs_dataset)
+    assert t._streamed, "budget should have forced streaming"
+    assert len(models) == 8
+    assert t._last_feed.peak_resident_chunks <= 2
 
 
 # ---------------------------------------------------------------------------
