@@ -74,7 +74,13 @@ def main(argv=None):
     # mirror --job mode: a failed deployment is a failed invocation.
     # Judge each job by its FINAL attempt (an early failure retried to
     # success across polls is a success), and fold signal-killed rcs
-    # (negative from subprocess.call) into plain failure
+    # (negative from subprocess.call) into plain failure.  A finite run
+    # that deployed NOTHING (no manifest entry matched any secret) is a
+    # failure too — a typo'd --secret must not read as success.
+    if args.max_polls is not None and not ran:
+        print("error: no manifest job matched the supplied secret(s)",
+              file=sys.stderr)
+        return 1
     final = {}
     for job in ran:
         final[job.job_name] = job.last_rc

@@ -268,3 +268,17 @@ def test_launch_cli_module_entry(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "DRY-RUN rsync" in proc.stdout
     assert "DRY-RUN ssh" in proc.stdout
+
+
+def test_launch_cli_manifest_no_match_fails(tmp_path, capsys):
+    """A finite manifest run where no job matched the secrets exits
+    nonzero — a typo'd --secret must not read as success."""
+    from dist_keras_tpu.launch.__main__ import main
+
+    jobdir = _write_jobdir(tmp_path)
+    mpath = tmp_path / "manifest.json"
+    mpath.write_text(json.dumps(
+        [{"secret": "good", "job_name": "a", "job_dir": str(jobdir),
+          "hosts": ["h0"]}]))
+    rc = main(["--manifest", str(mpath), "--secret", "typo", "--dry-run"])
+    assert rc == 1
