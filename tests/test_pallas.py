@@ -6,6 +6,8 @@ fallback are all checked against ``ops.attention`` on CPU; the same
 kernels run un-interpreted on TPU (`attention_auto` dispatch).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -153,3 +155,48 @@ def test_dead_rows_inside_visible_tile():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=1e-3)
     assert np.abs(np.asarray(g1[0])[:, :4]).max() == 0.0
+
+
+def test_fused_bwd_experiment_selfcheck_on_tpu(tmp_path):
+    """Round-5 experiment: the single-pass (dq HBM-aliased) backward
+    runs and self-checks on REAL TPU — in a subprocess on the host
+    platform (conftest pins this suite to CPU, where the aliased revisit
+    is structurally last-write-wins).  Exactness is REPORTED, not
+    asserted: it is compiler-dependent (the module docstring's coherence
+    table — the reason the kernel is opt-in, not the default).  Skips
+    when the host has no TPU."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "fused_check.py"
+    script.write_text(
+        "import jax, sys\n"
+        "if jax.devices()[0].platform != 'tpu':\n"
+        "    print('NO_TPU'); sys.exit(0)\n"
+        f"sys.path.insert(0, {repr(repo)})\n"
+        "from dist_keras_tpu.ops.pallas.fused_bwd_experimental import "
+        "selfcheck\n"
+        "for kw in ({'bh': 6, 't': 4096, 'block_q': 512,\n"
+        "            'block_k': 512},\n"
+        "           {'bh': 2, 't': 2048, 'block_q': 1024,\n"
+        "            'block_k': 1024}):\n"
+        "    ok, err = selfcheck(d=128, causal=True, **kw)\n"
+        "    print('SELFCHECK', kw, 'exact=', ok, 'err=', err)\n"
+        "    assert err == err and err < 10.0  # finite, sane\n"
+        "print('OK')\n")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    host = os.environ.get("DK_HOST_JAX_PLATFORMS")
+    if host:
+        env["JAX_PLATFORMS"] = host
+    env["PYTHONPATH"] = (repo + os.pathsep
+                         + os.environ.get("PYTHONPATH", "")).rstrip(
+                             os.pathsep)
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, env=env,
+                          timeout=900)
+    if "NO_TPU" in proc.stdout:
+        pytest.skip("no TPU on this host")
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "OK" in proc.stdout
