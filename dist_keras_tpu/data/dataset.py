@@ -49,6 +49,44 @@ class Dataset:
         return Dataset({c: df[c].to_numpy() for c in df.columns})
 
     @staticmethod
+    def from_spark(sdf):
+        """Spark DataFrame -> Dataset, via a pandas round trip — the
+        SURVEY §7 stage-6 adapter ("Spark survives only as an optional
+        data loader"): a reference user's existing Spark ETL output
+        drops straight into the TPU trainers.  Array-typed columns
+        (e.g. the reference's assembled feature vectors,
+        workflow.ipynb:~cell 12) become 2-D numpy columns, matching
+        ``from_csv``'s layout.
+
+        UNTESTED IN THIS IMAGE: no pyspark is installed here (and the
+        reference mount is empty) — the shim is a thin, reviewable
+        pandas bridge precisely so it carries no Spark-version-specific
+        surface.  ``sdf.toPandas()`` collects to the driver, which is
+        the reference's own behavior at training time
+        (trainers.py:~365 collects partitions to ship to workers)."""
+        # look the method up separately from calling it: an
+        # AttributeError raised INSIDE a genuine toPandas() (e.g. a
+        # pyspark/pandas version clash) must surface as itself, not as
+        # a misleading "not a Spark DataFrame" type error
+        to_pandas = getattr(sdf, "toPandas", None)
+        if to_pandas is None:
+            raise TypeError(
+                "from_spark expects a pyspark.sql.DataFrame (an object "
+                f"with .toPandas()); got {type(sdf).__name__}")
+        pdf = to_pandas()
+        if len(pdf) == 0:
+            raise ValueError(
+                "from_spark got an empty DataFrame (0 rows) — check the "
+                "upstream Spark query/filters")
+        cols = {}
+        for c in pdf.columns:
+            v = pdf[c].to_numpy()
+            if v.dtype == object:  # array<float> columns come back ragged
+                v = np.stack([np.asarray(e) for e in v])
+            cols[c] = v
+        return Dataset(cols)
+
+    @staticmethod
     def from_csv(path, **kw):
         from dist_keras_tpu.data.csv import read_csv
         return read_csv(path, **kw)

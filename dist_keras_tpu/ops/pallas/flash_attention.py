@@ -219,6 +219,47 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
+def _p_tile(q, k, lse, *, scale, causal, qi, ki, block_q, block_k,
+            q_offset, kv_offset):
+    """Recompute the (BQ, BK) f32 probability tile from q/k/lse — the
+    shared math of every backward kernel (dq, dk/dv, and the
+    experimental fused one; keeping ONE copy means a fix to e.g. the
+    dead-row threshold cannot silently diverge between them)."""
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        logits = _causal_mask(logits, qi, ki, block_q, block_k,
+                              q_offset, kv_offset)
+    # dead rows carry lse == -1e30; exp(logits - lse) would be 1
+    safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)
+    return jnp.exp(logits - safe_lse)
+
+
+def _ds_tile(p, do, v, dl):
+    """dS = P * (dO V^T + (g_lse - delta)) — shared by all backwards."""
+    dov = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return p * (dov + dl)
+
+
+def _bwd_q_index_map(causal, nq, block_q, block_k, q_offset, kv_offset):
+    """q-block index map for (bh, kv, q) grids.  Causal skipped tiles
+    sit at the START of the inner q loop (q blocks above the diagonal);
+    clamping the q index UP to the first visible block elides their
+    DMAs (see _kv_index_map)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def _q_clamp(b, i, j):
+        jmin = jnp.clip(
+            (kv_offset + i * block_k - q_offset) // block_q, 0, nq - 1)
+        return (b, jnp.maximum(j, jmin), 0)
+
+    return _q_clamp
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
                dq_scr, *, scale, causal, block_q, block_k, q_offset,
                kv_offset):
@@ -238,24 +279,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
     @pl.when(diag_visible)
     def _tile():
         # bf16 tiles straight into the MXU, f32 accumulation (see fwd)
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            logits = _causal_mask(logits, qi, ki, block_q, block_k,
-                                  q_offset, kv_offset)
-        # dead rows carry lse == -1e30; exp(logits - lse) would be 1
-        safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)
-        p = jnp.exp(logits - safe_lse)               # (BQ, BK) f32
-        dov = jax.lax.dot_general(                   # dO V^T
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dov + dl_ref[0].astype(jnp.float32))
+        p = _p_tile(q_ref[0], k, lse_ref[0].astype(jnp.float32),
+                    scale=scale, causal=causal, qi=qi, ki=ki,
+                    block_q=block_q, block_k=block_k, q_offset=q_offset,
+                    kv_offset=kv_offset)
+        ds = _ds_tile(p, do_ref[0], v_ref[0],
+                      dl_ref[0].astype(jnp.float32))
         dq_scr[...] += scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -284,25 +314,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
     def _tile():
         # bf16 tiles straight into the MXU, f32 accumulation (see fwd)
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0].astype(jnp.float32)         # (BQ, 1)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            logits = _causal_mask(logits, qi, ki, block_q, block_k,
-                                  q_offset, kv_offset)
-        safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)  # dead rows
-        p = jnp.exp(logits - safe_lse)               # (BQ, BK) f32
+        p = _p_tile(q, k_ref[0], lse_ref[0].astype(jnp.float32),
+                    scale=scale, causal=causal, qi=qi, ki=ki,
+                    block_q=block_q, block_k=block_k, q_offset=q_offset,
+                    kv_offset=kv_offset)
         dv_scr[...] += jax.lax.dot_general(          # P^T dO
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dov = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dov + dl_ref[0].astype(jnp.float32))
+        ds = _ds_tile(p, do, v_ref[0], dl_ref[0].astype(jnp.float32))
         dk_scr[...] += scale * jax.lax.dot_general(  # dS^T Q
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -335,18 +355,9 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         interpret=interpret,
         **_compiler_params(interpret),
     )(q, k, v, do, lse, dl)
-    # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block.
-    # Causal skipped tiles sit at the START of the inner q loop here
-    # (q blocks above the diagonal); clamping the q index UP to the
-    # first visible block elides their DMAs (see _kv_index_map).
-    nq = tq // block_q
-    if causal:
-        def _q_clamp(b, i, j):
-            jmin = jnp.clip(
-                (kv_offset + i * block_k - q_offset) // block_q, 0, nq - 1)
-            return (b, jnp.maximum(j, jmin), 0)
-    else:
-        _q_clamp = lambda b, i, j: (b, j, 0)  # noqa: E731
+    # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block
+    _q_clamp = _bwd_q_index_map(causal, tq // block_q, block_q, block_k,
+                                q_offset, kv_offset)
     qspec2 = pl.BlockSpec((1, block_q, d), _q_clamp)
     qrow2 = pl.BlockSpec((1, block_q, 1), _q_clamp)
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))

@@ -65,10 +65,11 @@ except Exception:  # pragma: no cover - CPU-only jax builds
     pltpu = None
 
 from dist_keras_tpu.ops.pallas.flash_attention import (
-    _NEG_INF,
     _bwd_call,
-    _causal_mask,
+    _bwd_q_index_map,
+    _ds_tile,
     _fwd_call,
+    _p_tile,
     _sds,
 )
 
@@ -92,24 +93,18 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     def _tile():
         q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0].astype(jnp.float32)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            logits = _causal_mask(logits, qi, ki, block_q, block_k,
-                                  q_offset, kv_offset)
-        safe_lse = jnp.where(lse <= _NEG_INF / 2, 0.0, lse)
-        p = jnp.exp(logits - safe_lse)
+        # shared tile math (flash_attention._p_tile/_ds_tile): this
+        # kernel differs from the default backward ONLY in the aliased
+        # dq accumulation below
+        p = _p_tile(q, k, lse_ref[0].astype(jnp.float32), scale=scale,
+                    causal=causal, qi=qi, ki=ki, block_q=block_q,
+                    block_k=block_k, q_offset=q_offset,
+                    kv_offset=kv_offset)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dov = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dov + dl_ref[0].astype(jnp.float32))
+        ds = _ds_tile(p, do, v_ref[0], dl_ref[0].astype(jnp.float32))
         dk_scr[...] += scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -139,16 +134,10 @@ def fused_bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         raise ImportError("pallas TPU helpers unavailable")
     bh, tq, d = q.shape
     tk = k.shape[1]
-    nq = tq // block_q
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, q_offset=q_offset, kv_offset=kv_offset)
-    if causal:
-        def _q_clamp(b, i, j):
-            jmin = jnp.clip(
-                (kv_offset + i * block_k - q_offset) // block_q, 0, nq - 1)
-            return (b, jnp.maximum(j, jmin), 0)
-    else:
-        _q_clamp = lambda b, i, j: (b, j, 0)  # noqa: E731
+    _q_clamp = _bwd_q_index_map(causal, tq // block_q, block_q, block_k,
+                                q_offset, kv_offset)
     qspec = pl.BlockSpec((1, block_q, d), _q_clamp)
     qrow = pl.BlockSpec((1, block_q, 1), _q_clamp)
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))

@@ -132,3 +132,28 @@ def test_csv_blank_lines_and_ragged_rows():
                 f.write(content)
             got, _ = read_numeric_csv(path)
             assert got.tolist() == want, content
+
+
+def test_from_spark_shim_pandas_bridge():
+    """from_spark is a pandas round trip (SURVEY §7 stage 6; no pyspark
+    in this image, so a duck-typed stand-in exercises the bridge):
+    array-typed columns stack into 2-D numpy like from_csv's layout."""
+    import pandas as pd
+    import pytest
+
+    from dist_keras_tpu.data import Dataset
+
+    class FakeSparkDF:
+        def toPandas(self):
+            return pd.DataFrame({
+                "features": [np.arange(4, dtype=np.float32) + i
+                             for i in range(6)],
+                "label": np.arange(6) % 2,
+            })
+
+    ds = Dataset.from_spark(FakeSparkDF())
+    assert ds["features"].shape == (6, 4)
+    assert ds["features"].dtype == np.float32
+    np.testing.assert_array_equal(ds["label"], np.arange(6) % 2)
+    with pytest.raises(TypeError, match="toPandas"):
+        Dataset.from_spark({"not": "a spark df"})
