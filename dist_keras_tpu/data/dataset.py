@@ -82,7 +82,14 @@ class Dataset:
         for c in pdf.columns:
             v = pdf[c].to_numpy()
             if v.dtype == object:  # array<float> columns come back ragged
-                v = np.stack([np.asarray(e) for e in v])
+                try:
+                    v = np.stack([np.asarray(e) for e in v])
+                except (ValueError, TypeError) as e:
+                    raise ValueError(
+                        f"from_spark: column {c!r} has rows that do not "
+                        "stack into one array — variable-length arrays "
+                        "or NULL entries; pad/filter them in Spark "
+                        "first") from e
             cols[c] = v
         return Dataset(cols)
 

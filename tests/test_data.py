@@ -157,3 +157,17 @@ def test_from_spark_shim_pandas_bridge():
     np.testing.assert_array_equal(ds["label"], np.arange(6) % 2)
     with pytest.raises(TypeError, match="toPandas"):
         Dataset.from_spark({"not": "a spark df"})
+
+
+def test_from_spark_ragged_column_names_the_column():
+    import pandas as pd
+    import pytest
+
+    from dist_keras_tpu.data import Dataset
+
+    class RaggedSDF:
+        def toPandas(self):
+            return pd.DataFrame({"feats": [np.zeros(3), np.zeros(4)]})
+
+    with pytest.raises(ValueError, match="'feats'"):
+        Dataset.from_spark(RaggedSDF())
