@@ -165,6 +165,13 @@ def _run_trainer_config(name, make_trainer, ds, batch, flops_per_sample,
         sps_runs.append(samples / dt / nchips)
     med = float(np.median(sps_runs))
     spread = (max(sps_runs) - min(sps_runs)) / med if med else None
+    # the tunnel occasionally stalls ONE run several-fold (measured in
+    # round 5: a 12.9M outlier among seven ~89M AEASGD runs), which
+    # destroys the raw spread while the median stays robust — report a
+    # trimmed spread over runs within 1.5x of the median alongside the
+    # raw one, with the outlier count recorded rather than hidden
+    good = [s for s in sps_runs if med / 1.5 <= s <= med * 1.5] or sps_runs
+    trimmed = (max(good) - min(good)) / med if med else None
     mfu = (med * flops_per_sample / peak
            if (peak and flops_per_sample) else None)
     return {
@@ -172,6 +179,9 @@ def _run_trainer_config(name, make_trainer, ds, batch, flops_per_sample,
         "samples_per_sec_per_chip": round(med, 1),
         "n_runs": runs,
         "spread": round(spread, 4) if spread is not None else None,
+        "trimmed_spread": (round(trimmed, 4) if trimmed is not None
+                           else None),
+        "n_outlier_runs": len(sps_runs) - len(good),
         "runs": [round(s, 1) for s in sps_runs],
         "flops_per_sample": flops_per_sample,
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -223,13 +233,13 @@ def bench_aeasgd_higgs(peak):
     from dist_keras_tpu.trainers import AEASGD
     from dist_keras_tpu.utils.misc import one_hot
 
-    # 3200 epochs (~400M samples, a ~6 s window): the tiny MLP runs
-    # ~65M samples/s, so a short window leaves the tunnel's +-50 ms
+    # 6400 epochs (~800M samples, a ~9 s window): the tiny MLP runs
+    # ~86M samples/s, so a short window leaves the tunnel's +-50 ms
     # dispatch jitter as a double-digit error bar — round 3's 400-epoch
-    # window measured a 10.7% spread, round 4's 1600-epoch window 4.5%.
-    # Stretching to 3200 epochs + median-of-7 targets the <=2% spread
-    # VERDICT r4 asked for (jitter ~1% of a 6 s window).
-    batch, steps, epochs = 1024, 120, 3200
+    # window measured a 10.7% spread, round 4's 1600-epoch window 4.5%,
+    # round 5's first try at 3200 epochs 2.9%.  Doubling again +
+    # median-of-7 lands the <=2% spread VERDICT r4 asked for.
+    batch, steps, epochs = 1024, 120, 6400
     rng = np.random.default_rng(0)
     n = batch * steps
     y = rng.integers(0, 2, n)

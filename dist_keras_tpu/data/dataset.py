@@ -90,6 +90,14 @@ class Dataset:
                         "stack into one array — variable-length arrays "
                         "or NULL entries; pad/filter them in Spark "
                         "first") from e
+                if v.dtype == object:
+                    # all-NULL columns stack "successfully" into an
+                    # object array of Nones — catch it here, not as a
+                    # cryptic device-transfer dtype error later
+                    raise ValueError(
+                        f"from_spark: column {c!r} stacked to a non-"
+                        "numeric object array (NULL rows?); pad/filter "
+                        "them in Spark first")
             cols[c] = v
         return Dataset(cols)
 

@@ -171,3 +171,18 @@ def test_from_spark_ragged_column_names_the_column():
 
     with pytest.raises(ValueError, match="'feats'"):
         Dataset.from_spark(RaggedSDF())
+
+
+def test_from_spark_all_null_column_raises():
+    import pandas as pd
+    import pytest
+
+    from dist_keras_tpu.data import Dataset
+
+    class NullSDF:
+        def toPandas(self):
+            return pd.DataFrame({"feats": pd.Series([None, None],
+                                                    dtype=object)})
+
+    with pytest.raises(ValueError, match="'feats'"):
+        Dataset.from_spark(NullSDF())

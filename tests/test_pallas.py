@@ -193,6 +193,21 @@ def test_fused_bwd_experiment_selfcheck_on_tpu(tmp_path):
     env["PYTHONPATH"] = (repo + os.pathsep
                          + os.environ.get("PYTHONPATH", "")).rstrip(
                              os.pathsep)
+    # preflight probe: skip ONLY on a wedged tunnel — a timeout of the
+    # real run below must stay a failure (it could be a genuine kernel
+    # deadlock, which a blanket skip would ship unnoticed)
+    probe = tmp_path / "tpu_probe.py"
+    probe.write_text(
+        "import jax, jax.numpy as jnp\n"
+        "print('probe', float((jnp.ones((8, 8)) @ jnp.ones((8, 8)))"
+        ".sum()), flush=True)\n")
+    try:
+        subprocess.run([sys.executable, str(probe)],
+                       capture_output=True, text=True, env=env,
+                       timeout=180)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend unresponsive (probe matmul timed out "
+                    "after 180s — tunnel outage)")
     proc = subprocess.run([sys.executable, str(script)],
                           capture_output=True, text=True, env=env,
                           timeout=900)
