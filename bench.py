@@ -585,6 +585,25 @@ def bench_adag_streamed(peak):
     }
 
 
+def _honor_platform_env():
+    """The image preloads jax via a sitecustomize bound to the TPU
+    tunnel; a JAX_PLATFORMS env override needs the config forced too
+    (same pattern as tests/conftest.py and the examples) — without it a
+    CPU-pinned bench run can hang on a wedged tunnel backend."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        except Exception as e:  # pragma: no cover - init-order quirks
+            # do NOT die, but leave evidence: a silent failure here
+            # reproduces exactly the tunnel-hang this function prevents
+            print(f"[bench] WARNING: could not force jax_platforms="
+                  f"{os.environ['JAX_PLATFORMS']}: {e!r}",
+                  file=sys.stderr, flush=True)
+
+
 def _enable_compilation_cache():
     """Persistent XLA compilation cache (verified to work through the
     axon remote-compile tunnel: 2nd process compile 3.9 s -> 0.1 s).
@@ -650,6 +669,7 @@ def main():
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
     atexit.register(_emit, last=True)
+    _honor_platform_env()
     _enable_compilation_cache()
     peak = _peak_flops()
     _OUT["peak_tflops"] = peak / 1e12 if peak else None
