@@ -669,11 +669,16 @@ def main():
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
     atexit.register(_emit, last=True)
+    # flush a parseable record BEFORE the first jax/device touch: a
+    # wedged tunnel backend hangs jax.devices() in a C-level RPC where
+    # even the SIGTERM handler cannot run (observed in this session's
+    # multi-hour outage) — the pre-emitted line is then the record
+    _emit()
     _honor_platform_env()
     _enable_compilation_cache()
     peak = _peak_flops()
     _OUT["peak_tflops"] = peak / 1e12 if peak else None
-    _emit()  # a parseable record exists before the first config runs
+    _emit()  # record updated with the chip's peak
 
     # headline first, then the remaining reference-parity rows cheapest
     # first, then the internal parity ratio, then the no-baseline

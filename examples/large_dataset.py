@@ -3,7 +3,9 @@
 The reference's core workload is the big-DataFrame case: Spark streams
 each worker's partition through an iterator (workers.py:~60), so an
 epoch never has to fit in any executor's memory.  The TPU-native
-equivalent (round 4): the windowed family and DynSGD accept
+equivalent (round 4; round 5 extended it to EVERY trainer — the
+windowed family, DynSGD, SingleTrainer, AveragingTrainer, and
+EnsembleTrainer all stream, so no trainer is HBM-capped):
 
 - ``stream_chunk_windows=C`` — feed C communication windows per
   dispatch through a double-buffered ChunkFeed: at most TWO chunks

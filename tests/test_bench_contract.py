@@ -58,27 +58,35 @@ def test_sigterm_mid_run_flushes_parseable_record():
         [sys.executable, os.path.join(REPO, "bench.py")],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env)
-    # wait for the pre-config record line (bench emits one before the
-    # first config) instead of a blind sleep, so a startup crash fails
-    # with its stderr rather than a cryptic missing-key error later
-    deadline = time.time() + 120
-    first_line = None
-    while time.time() < deadline:
-        line = proc.stdout.readline()
-        if line.strip():
-            first_line = line
-            break
-        if proc.poll() is not None:
-            pytest.fail("bench died before emitting a record: "
-                        + proc.stderr.read()[-2000:])
-    assert first_line, "no record line within 120s"
-    json.loads(first_line)  # the pre-config record parses
-    proc.send_signal(signal.SIGTERM)
     try:
-        stdout, stderr = proc.communicate(timeout=120)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        pytest.fail("bench did not exit after SIGTERM")
-    rec = _last_record(first_line + stdout)
-    assert rec["terminated_by"] == "SIGTERM", stderr[-2000:]
-    assert rec["partial"] is True  # the config loop did NOT complete
+        # wait for the pre-config record line (bench emits one before
+        # any jax/device touch) with a REAL deadline — a blocking
+        # readline would hang the test on exactly the wedged-backend
+        # scenario this hardening targets
+        os.set_blocking(proc.stdout.fileno(), False)
+        deadline = time.time() + 120
+        first_line = ""
+        while time.time() < deadline and "\n" not in first_line:
+            chunk = proc.stdout.read()
+            if chunk:
+                first_line += chunk
+            elif proc.poll() is not None:
+                pytest.fail("bench died before emitting a record: "
+                            + proc.stderr.read()[-2000:])
+            else:
+                time.sleep(0.2)
+        assert "\n" in first_line, "no record line within 120s"
+        json.loads(first_line.strip().splitlines()[0])  # it parses
+        os.set_blocking(proc.stdout.fileno(), True)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            pytest.fail("bench did not exit after SIGTERM")
+        rec = _last_record(first_line + stdout)
+        assert rec["terminated_by"] == "SIGTERM", stderr[-2000:]
+        assert rec["partial"] is True  # config loop did NOT complete
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
