@@ -56,35 +56,57 @@ def test_sigterm_mid_run_flushes_parseable_record():
     env["BENCH_BUDGET_S"] = "3600"  # would actually run configs
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
     try:
         # wait for the pre-config record line (bench emits one before
         # any jax/device touch) with a REAL deadline — a blocking
         # readline would hang the test on exactly the wedged-backend
-        # scenario this hardening targets
+        # scenario this hardening targets.  Binary pipes: non-blocking
+        # reads on a text wrapper raise on empty reads.
         os.set_blocking(proc.stdout.fileno(), False)
+        # _emit prefixes a newline (line-boundary guarantee), so wait
+        # for a non-empty completed line, not just any newline
+        def _first_record(b):
+            *done, _tail = b.split(b"\n")
+            for ln in done:
+                if ln.strip():
+                    return ln
+            return None
+
         deadline = time.time() + 120
-        first_line = ""
-        while time.time() < deadline and "\n" not in first_line:
-            chunk = proc.stdout.read()
+        buf = b""
+        while time.time() < deadline and _first_record(buf) is None:
+            try:
+                chunk = os.read(proc.stdout.fileno(), 65536)
+            except BlockingIOError:
+                chunk = b""
             if chunk:
-                first_line += chunk
+                buf += chunk
             elif proc.poll() is not None:
-                pytest.fail("bench died before emitting a record: "
-                            + proc.stderr.read()[-2000:])
+                # drain once more before declaring death: the record
+                # may have landed in the pipe between the empty read
+                # and the exit (atexit flushes on crash paths)
+                try:
+                    buf += os.read(proc.stdout.fileno(), 65536)
+                except BlockingIOError:
+                    pass
+                if _first_record(buf) is None:
+                    pytest.fail("bench died before emitting a record: "
+                                + proc.stderr.read().decode()[-2000:])
+                break
             else:
                 time.sleep(0.2)
-        assert "\n" in first_line, "no record line within 120s"
-        json.loads(first_line.strip().splitlines()[0])  # it parses
+        line = _first_record(buf)
+        assert line is not None, "no record line within 120s"
+        json.loads(line.decode())  # the pre-config record parses
         os.set_blocking(proc.stdout.fileno(), True)
         proc.send_signal(signal.SIGTERM)
         try:
             stdout, stderr = proc.communicate(timeout=120)
         except subprocess.TimeoutExpired:
             pytest.fail("bench did not exit after SIGTERM")
-        rec = _last_record(first_line + stdout)
-        assert rec["terminated_by"] == "SIGTERM", stderr[-2000:]
+        rec = _last_record((buf + stdout).decode())
+        assert rec["terminated_by"] == "SIGTERM", stderr.decode()[-2000:]
         assert rec["partial"] is True  # config loop did NOT complete
     finally:
         if proc.poll() is None:
