@@ -585,6 +585,42 @@ def bench_adag_streamed(peak):
     }
 
 
+def _backend_responsive(timeout_s=180):
+    """Probe the default backend in a SUBPROCESS with a hard timeout.
+
+    A wedged tunnel backend hangs ``jax.devices()`` inside a C-level
+    RPC that not even signal handlers interrupt (observed: a multi-hour
+    outage in this image).  Probing in-process would therefore hang the
+    whole bench un-killably; a subprocess can simply be timed out, and
+    the suite then records WHY it measured nothing instead of dying
+    recordless."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             # the probe must honor JAX_PLATFORMS the same way main()
+             # does — the sitecustomize preload binds the tunnel
+             # backend regardless of env otherwise
+             "import os, jax\n"
+             "if os.environ.get('JAX_PLATFORMS'):\n"
+             "    try:\n"
+             "        jax.config.update('jax_platforms',"
+             " os.environ['JAX_PLATFORMS'])\n"
+             "    except Exception:\n"
+             "        pass  # same tolerance as _honor_platform_env\n"
+             "import jax.numpy as jnp\n"
+             "print(float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()),"
+             " jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"device probe timed out after {timeout_s}s"
+    if proc.returncode != 0:
+        return False, (f"device probe failed (rc={proc.returncode}): "
+                       + proc.stderr[-300:])
+    return True, proc.stdout.strip()
+
+
 def _honor_platform_env():
     """The image preloads jax via a sitecustomize bound to the TPU
     tunnel; a JAX_PLATFORMS env override needs the config forced too
@@ -675,6 +711,15 @@ def main():
     # multi-hour outage) — the pre-emitted line is then the record
     _emit()
     _honor_platform_env()
+    ok, detail = _backend_responsive()
+    if not ok:
+        # partial stays TRUE: no config ran, so the record must not
+        # read as a completed measurement — the reason field says why
+        _OUT["backend_unresponsive"] = detail
+        print(f"[bench] backend unresponsive, measuring nothing: "
+              f"{detail}", file=sys.stderr, flush=True)
+        _emit(last=True)
+        return
     _enable_compilation_cache()
     peak = _peak_flops()
     _OUT["peak_tflops"] = peak / 1e12 if peak else None
