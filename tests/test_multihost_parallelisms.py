@@ -290,32 +290,37 @@ def test_two_process_ensemble_mps2_and_barrier(tmp_path):
     _assert_same(w0, w1, ref)
 
 
-def _pp_body():
-    return r"""
+def _pp_body(layers, m, steps, virtual):
+    return rf"""
 import optax
 from dist_keras_tpu.models.transformer import transformer_config
 from dist_keras_tpu.parallel.pipeline import (make_pp_mesh,
                                               train_pp_transformer)
 
 cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
-                         n_layers=8, n_classes=2)
-mesh = make_pp_mesh(stages=8)   # stages span BOTH hosts: the per-tick
-rng = np.random.default_rng(0)  # ppermute crosses the process boundary
+                         n_layers={layers}, n_classes=2)
+mesh = make_pp_mesh(stages=8)   # stages span BOTH hosts: every ring
+rng = np.random.default_rng(0)  # permute crosses the process boundary
 x = rng.normal(size=(8, 8, 4)).astype(np.float32)
 y = rng.integers(0, 2, 8).astype(np.int32)
 (rest, blocks), losses = train_pp_transformer(
-    mesh, cfg, x, y, num_microbatches=4, steps=3,
-    optimizer=optax.adam(1e-2), causal=True, seed=0)
+    mesh, cfg, x, y, num_microbatches={m}, steps={steps},
+    optimizer=optax.adam(1e-2), causal=True, seed=0, virtual={virtual})
 import jax
 leaves = jax.tree.leaves((rest, blocks))
 """
 
 
-def test_two_process_pp_matches_single_process(tmp_path):
+@pytest.mark.parametrize("layers,m,steps,virtual",
+                         [(8, 4, 3, 1), (16, 8, 2, 2)])
+def test_two_process_pp_matches_single_process(tmp_path, layers, m,
+                                               steps, virtual):
     """1F1B pipeline over a stages axis spanning 2 processes — the
     per-tick activation ppermute crosses the host boundary (round-3
-    VERDICT: exactly where a layout bug would hide)."""
-    w0, w1 = _run_pair(tmp_path, _pp_body())
+    VERDICT: exactly where a layout bug would hide).  virtual=2 is the
+    round-5 interleaved engine: the forward ring, the REVERSE cotangent
+    ring, and the chunk-transition wraparounds all cross the boundary."""
+    w0, w1 = _run_pair(tmp_path, _pp_body(layers, m, steps, virtual))
 
     import jax
     import optax
@@ -327,14 +332,14 @@ def test_two_process_pp_matches_single_process(tmp_path):
     )
 
     cfg = transformer_config(input_dim=4, seq_len=8, d_model=8, n_heads=2,
-                             n_layers=8, n_classes=2)
+                             n_layers=layers, n_classes=2)
     mesh = make_pp_mesh(stages=8)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(8, 8, 4)).astype(np.float32)
     y = rng.integers(0, 2, 8).astype(np.int32)
     (rest, blocks), _ = train_pp_transformer(
-        mesh, cfg, x, y, num_microbatches=4, steps=3,
-        optimizer=optax.adam(1e-2), causal=True, seed=0)
+        mesh, cfg, x, y, num_microbatches=m, steps=steps,
+        optimizer=optax.adam(1e-2), causal=True, seed=0, virtual=virtual)
     _assert_same(w0, w1, jax.tree.leaves((rest, blocks)))
 
 
