@@ -695,7 +695,10 @@ def _emit(last=False):
 def _on_signal(signum, frame):  # pragma: no cover - driver-kill path
     _OUT["terminated_by"] = signal.Signals(signum).name
     _emit(last=True)
-    os._exit(0)
+    # conventional 128+signum (SIGTERM -> 143): a timeout-killing driver
+    # that checks the return code sees failure, not a silent success —
+    # the record line is flushed either way (ADVICE r5)
+    os._exit(128 + signum)
 
 
 def main():

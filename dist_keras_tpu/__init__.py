@@ -9,7 +9,15 @@ See SURVEY.md at the repo root for the reference blueprint this implements.
 
 __version__ = "0.1.0"
 
-from dist_keras_tpu import data, models, ops, parallel, trainers, utils
+from dist_keras_tpu import (
+    data,
+    models,
+    ops,
+    parallel,
+    resilience,
+    trainers,
+    utils,
+)
 from dist_keras_tpu.data import (
     AccuracyEvaluator,
     AUCEvaluator,
@@ -35,7 +43,7 @@ from dist_keras_tpu.trainers import (
 )
 
 __all__ = [
-    "data", "models", "ops", "parallel", "trainers", "utils",
+    "data", "models", "ops", "parallel", "resilience", "trainers", "utils",
     "Dataset", "ModelPredictor",
     "MinMaxTransformer", "OneHotTransformer", "LabelIndexTransformer",
     "ReshapeTransformer", "DenseTransformer", "StandardScaleTransformer",

@@ -9,6 +9,19 @@ call Keras ``model.save``.  Here:
 - ``Checkpointer``: step-indexed training-state snapshots (params +
   optimizer state + any counters as one pytree) with retention, resume to
   the latest step, and async-friendly orbax IO underneath.
+
+Round 6 — preemption-safe commits: every save writes to ``step_N.tmp``,
+fsyncs, then renames to ``step_N``; an overwrite of an existing step
+first RETIRES the committed copy to ``step_N.old`` (journaled swap), and
+readers COUNT and READ THROUGH a stranded ``.old``, so a kill at ANY
+instant leaves either the previous committed set or the new one — never
+a half-write, never a lost committed step.  Read queries are strictly
+read-only (a polling monitor can never interfere with a live writer);
+the writer garbage-collects orphaned tmp/staging dirs and superseded
+``.old`` copies after its next successful commit.  Transient write errors are retried
+(``resilience.retry``); the mid-write and mid-swap instants are named
+fault points (``"checkpoint.save"`` / ``"checkpoint.commit"``) so every
+kill scenario is deterministically testable.
 """
 
 from __future__ import annotations
@@ -29,6 +42,39 @@ except Exception:  # pragma: no cover - orbax is in the image
     _HAVE_ORBAX = False
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _fsync_dir(path):
+    """fsync a DIRECTORY so a just-committed rename survives power loss
+    (POSIX: the rename itself lives in the parent dir's entries)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. non-POSIX filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root):
+    """fsync every file under ``root`` plus the directories themselves —
+    the write half of the write->fsync->rename commit protocol."""
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            try:
+                fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+            except OSError:  # pragma: no cover - raced file
+                continue
+            try:
+                os.fsync(fd)
+            except OSError:  # pragma: no cover
+                pass
+            finally:
+                os.close(fd)
+        _fsync_dir(dirpath)
 
 
 def save_model(model, path):
@@ -63,43 +109,132 @@ class Checkpointer:
     directory; falls back to pickled-npz when orbax is unavailable.
     """
 
-    def __init__(self, directory, max_to_keep=3):
+    def __init__(self, directory, max_to_keep=3, fsync=True, retry=None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = int(max_to_keep)
+        self.fsync = bool(fsync)
+        # transient FS errors (NFS hiccup, disk-full races with retention)
+        # are retried; FaultInjected is deliberately NOT retryable, so an
+        # injected mid-write kill stays a kill (guards the test contract)
+        if retry is None:
+            from dist_keras_tpu.resilience.retry import RetryPolicy
+
+            retry = RetryPolicy(attempts=3, backoff=0.05, jitter=0.0,
+                                retryable=(OSError,))
+        self._retry = retry
+        self._inflight = None  # "step_NNNNNNNN" currently being written
         self._ckpt = ocp.StandardCheckpointer() if _HAVE_ORBAX else None
 
     def _step_dir(self, step):
         return os.path.join(self.directory, f"step_{step:08d}")
 
     def all_steps(self):
-        steps = []
+        """Committed steps — STRICTLY read-only, so any number of
+        concurrent pollers (a monitor calling ``latest_step`` in a loop)
+        can never interfere with a live writer.  A step whose overwrite
+        was killed mid-swap (``step_N.old`` present, ``step_N`` missing)
+        still COUNTS: ``restore`` reads through the retired copy, and
+        the writer's next successful save cleans it up.  Orphaned
+        tmp/staging dirs are ignored here for the same reason."""
+        steps = set()
+        retired = set()
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
-            if m:  # skips orbax tmp dirs left by an interrupted save
-                steps.append(int(m.group(1)))
-        return sorted(steps)
+            if m:
+                steps.add(int(m.group(1)))
+            elif name.endswith(".old") and _STEP_RE.match(name[:-4]):
+                retired.add(int(name[:-4].split("_")[1]))
+        return sorted(steps | retired)
+
+    def _read_path(self, step):
+        """Where ``step``'s data lives: the committed dir, or the
+        retired ``.old`` copy if an overwrite was killed mid-swap."""
+        final = self._step_dir(step)
+        if not os.path.exists(final) and os.path.exists(final + ".old"):
+            return final + ".old"
+        return final
+
+    def _gc_orphans(self):
+        """Writer-side sweep (after a successful commit): remove staging
+        dirs no save will ever commit — interrupted ``step_N.tmp``,
+        orbax staging leftovers, and ``.old`` copies whose final exists.
+        Never runs from read-only queries."""
+        import shutil
+
+        for name in os.listdir(self.directory):
+            full = os.path.join(self.directory, name)
+            if not name.startswith("step_") or _STEP_RE.match(name):
+                continue
+            if self._inflight and name.startswith(self._inflight):
+                continue
+            if name.endswith(".old") and _STEP_RE.match(name[:-4]):
+                if os.path.exists(full[:-4]):  # superseded retired copy
+                    shutil.rmtree(full, ignore_errors=True)
+                continue  # sole copy of its step: keep (read path)
+            shutil.rmtree(full, ignore_errors=True)
 
     def latest_step(self):
         steps = self.all_steps()
         return steps[-1] if steps else None
 
     def save(self, step, state):
+        """Atomic, retried commit: tmp-dir write -> fsync -> rename.
+
+        A kill at any instant leaves the directory with either the old
+        committed steps or old + new — ``restore`` can never observe a
+        partial write.  The window between write and commit is the
+        ``"checkpoint.save"`` fault point.
+        """
         state = _to_host(state)
-        path = self._step_dir(step)
+        final = self._step_dir(step)
+        tmp = final + ".tmp"
+        self._inflight = os.path.basename(final)
+        try:
+            self._retry.call(self._save_once, tmp, final, state)
+            self._gc_orphans()
+        finally:
+            self._inflight = None
+        self._retain()
+
+    def _save_once(self, tmp, final, state):
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        import shutil
+
+        # a retry (or an earlier interrupted save of the same step)
+        # may have left either path behind — start clean
+        shutil.rmtree(tmp, ignore_errors=True)
         if self._ckpt is not None:
-            self._ckpt.save(path, state, force=True)
+            self._ckpt.save(tmp, state, force=True)
             self._ckpt.wait_until_finished()
         else:
             # fallback: pickle the host pytree — symmetric with the
             # fallback restore below, so a checkpoint written without
             # orbax is readable anywhere
-            os.makedirs(path, exist_ok=True)
+            os.makedirs(tmp, exist_ok=True)
             import pickle
 
-            with open(os.path.join(path, "state.pkl"), "wb") as f:
+            with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-        self._retain()
+        if self.fsync:
+            _fsync_tree(tmp)
+        # the deterministic mid-write kill: tmp written, not yet committed
+        fault_point("checkpoint.save")
+        # journaled overwrite swap: the committed version is RETIRED to
+        # step_N.old (not deleted) before the new one lands, so a kill
+        # between the two renames loses nothing — all_steps() rolls the
+        # .old back when it finds no committed final
+        trash = final + ".old"
+        if os.path.exists(final):
+            shutil.rmtree(trash, ignore_errors=True)  # stale leftover
+            os.rename(final, trash)
+        # the deterministic mid-swap kill (old retired, new not committed)
+        fault_point("checkpoint.commit")
+        os.rename(tmp, final)
+        shutil.rmtree(trash, ignore_errors=True)  # new committed: old goes
+        if self.fsync:
+            _fsync_dir(self.directory)  # persist the renames themselves
 
     def restore(self, step=None, template=None):
         """Restore ``step`` (default: latest). ``template``: a pytree with
@@ -108,7 +243,7 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        path = self._step_dir(step)
+        path = self._read_path(step)
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):  # fallback-format checkpoint
             import pickle
@@ -131,3 +266,5 @@ class Checkpointer:
             import shutil
 
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
+            shutil.rmtree(self._step_dir(step) + ".old",
+                          ignore_errors=True)

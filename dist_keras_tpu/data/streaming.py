@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from dist_keras_tpu.data.predictors import Predictor
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.resilience.retry import RetryPolicy
 from dist_keras_tpu.utils.serialization import deserialize_model
 
 _SENTINEL = object()
@@ -235,22 +237,36 @@ class StreamingPredictor(Predictor):
     """
 
     def __init__(self, keras_model, batch_size=256, max_latency_s=0.05,
-                 poll_timeout_s=0.01):
+                 poll_timeout_s=0.01, fetch_retry=None):
         super().__init__(keras_model)  # serialized-model round-trip
         self.batch_size = int(batch_size)
         self.max_latency_s = float(max_latency_s)
         self.poll_timeout_s = float(poll_timeout_s)
+        # transient transport errors (a reconnecting producer surfaces as
+        # OSError/ConnectionError from the socket layer) are retried; a
+        # clean end-of-stream or a RuntimeError stream failure is final
+        self.fetch_retry = fetch_retry or RetryPolicy(
+            attempts=3, backoff=0.02, jitter=0.0, retryable=(OSError,))
         model = deserialize_model(self.serialized)
         params = model.params
         apply_fn = model.apply
         self._predict = jax.jit(lambda x: apply_fn(params, x))
+
+    def _fetch(self, source):
+        """One retried poll of the source (the ``"stream.fetch"`` fault
+        point covers each attempt)."""
+        def attempt():
+            fault_point("stream.fetch")
+            return source.get(self.poll_timeout_s)
+
+        return self.fetch_retry.call(attempt)
 
     def predict_stream(self, source):
         """-> generator of (rows (n, F), predictions (n, C)) micro-batches."""
         pending = []
         deadline = None
         while True:
-            row = source.get(self.poll_timeout_s)
+            row = self._fetch(source)
             now = time.monotonic()
             if row is not None:
                 pending.append(np.asarray(row, dtype=np.float32))

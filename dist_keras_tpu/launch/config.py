@@ -37,6 +37,13 @@ class JobConfig:
     num_processes: int | None = None
     remote_root: str = "~/jobs"
     python: str = "python3"
+    # per-command transient-fault budget (round 6): extra rsync attempts
+    # per host, exponential backoff from retry_backoff seconds.  The
+    # launch ssh is NOT retried unless launch_retries > 0 — its remote
+    # nohup is not idempotent (see Job.__init__)
+    retries: int = 2
+    retry_backoff: float = 0.5
+    launch_retries: int = 0
 
     # operator-facing JSON surface: validate types, not just names — a
     # string where a list belongs (hosts: "localhost") would otherwise
@@ -44,7 +51,9 @@ class JobConfig:
     _TYPES = {"job_name": str, "job_dir": str, "secret": str,
               "entrypoint": str, "hosts": (list, tuple),
               "coordinator_port": int, "num_processes": (int, type(None)),
-              "remote_root": str, "python": str}
+              "remote_root": str, "python": str,
+              "retries": int, "retry_backoff": (int, float),
+              "launch_retries": int}
 
     @classmethod
     def from_dict(cls, d):

@@ -12,6 +12,14 @@ reference's design is infrastructure-agnostic and survives unchanged.
 
 ``dry_run=True`` collects the command lines instead of executing them, which
 is also how the unit tests exercise this layer without a cluster.
+
+Round 6 — transient-fault absorption: each per-host rsync/ssh command is
+retried with backoff (``retries`` attempts beyond the first; the
+``"job.rsync"`` / ``"job.ssh"`` fault points let tests fail exactly the
+Nth command without a cluster), and ``Punchcard.read_manifest`` retries
+torn reads (a writer mid-rewrite is a transient JSON error, not a dead
+manifest).  A job that still fails after its retry budget keeps the
+previous semantics: nonzero rc, re-attempted on the next poll.
 """
 
 from __future__ import annotations
@@ -23,10 +31,22 @@ import shlex
 import subprocess
 import time
 
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.resilience.retry import RetryPolicy
+
 _SAFE_NAME = re.compile(r"^[A-Za-z0-9._-]+$")
 # user@host, hostnames, IPv4/IPv6 — must not start with '-' (ssh/rsync
 # would parse it as an option)
 _SAFE_HOST = re.compile(r"^[A-Za-z0-9_\[][A-Za-z0-9._@:\[\]-]*$")
+
+
+class CommandFailed(OSError):
+    """A per-host rsync/ssh command returned nonzero — retryable."""
+
+    def __init__(self, cmd, rc):
+        super().__init__(f"rc={rc}: {' '.join(map(str, cmd))}")
+        self.cmd = cmd
+        self.rc = int(rc)
 
 
 class Job:
@@ -44,7 +64,8 @@ class Job:
 
     def __init__(self, secret, job_name, job_dir, entrypoint="main.py",
                  hosts=(), coordinator_port=8476, num_processes=None,
-                 remote_root="~/jobs", python="python3", dry_run=False):
+                 remote_root="~/jobs", python="python3", dry_run=False,
+                 retries=2, retry_backoff=0.5, launch_retries=0):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -70,26 +91,61 @@ class Job:
         self.remote_root = remote_root
         self.python = python
         self.dry_run = dry_run
+        # per-command retry budget: ``retries`` extra attempts with
+        # exponential backoff from ``retry_backoff`` seconds — a flaky
+        # rsync hop no longer needs an operator re-send.  The LAUNCH ssh
+        # is NOT retried by default (``launch_retries=0``): its remote
+        # ``nohup ... &`` is not idempotent, so a connection dropped
+        # AFTER the fork would re-start a duplicate training process on
+        # that host (two processes claiming one jax.distributed id).  A
+        # failed launch surfaces as nonzero rc; Punchcard's next poll
+        # re-sends the whole job — the operator-visible, job-granular
+        # retry.  Raise ``launch_retries`` only if your entrypoint
+        # guards itself against double-start.
+        self.retry_policy = RetryPolicy(
+            attempts=int(retries) + 1, backoff=float(retry_backoff),
+            jitter=0.1, retryable=(CommandFailed,))
+        self.launch_retry_policy = RetryPolicy(
+            attempts=int(launch_retries) + 1, backoff=float(retry_backoff),
+            jitter=0.1, retryable=(CommandFailed,))
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
-    def _run(self, cmd):
+    def _run(self, cmd, point=None):
         self.commands.append(cmd)
-        if self.dry_run:
+        rc = 0 if self.dry_run else subprocess.call(cmd)
+        if point is not None:
+            # fault hook: a replace-fault forges the return code, so a
+            # flaky transport is simulated without a cluster
+            rc = fault_point(point, value=rc)
+        return rc
+
+    def _run_retried(self, cmd, point, policy=None):
+        """One per-host command under a retry policy; returns the last
+        attempt's rc (0 on eventual success)."""
+        def attempt():
+            rc = self._run(cmd, point=point)
+            if rc != 0:
+                raise CommandFailed(cmd, rc)
             return 0
-        return subprocess.call(cmd)
+
+        try:
+            return (policy or self.retry_policy).call(attempt)
+        except CommandFailed as e:
+            return e.rc
 
     def _remote_dir(self):
         return f"{self.remote_root}/{self.job_name}"
 
     # -- API (send ~ job_deployment.py:~60) ----------------------------
     def sync(self):
-        """rsync the job directory to every host."""
+        """rsync the job directory to every host (each host's command
+        retried with backoff before counting as failed)."""
         rc = 0
         for host in self.hosts:
-            rc |= self._run([
+            rc |= self._run_retried([
                 "rsync", "-az", "--delete", self.job_dir + "/",
-                f"{host}:{self._remote_dir()}/"])
+                f"{host}:{self._remote_dir()}/"], point="job.rsync")
         return rc
 
     def host_env(self, pid):
@@ -119,11 +175,14 @@ class Job:
             # then quote each word
             python = " ".join(shlex.quote(w)
                               for w in shlex.split(self.python))
-            rc |= self._run([
+            # non-idempotent (remote nohup fork): retried only when the
+            # operator opted in via launch_retries — see __init__
+            rc |= self._run_retried([
                 "ssh", host,
                 f"cd {self._remote_dir()} && {env} nohup "
                 f"{python} {shlex.quote(self.entrypoint)} "
-                f"> job.log 2>&1 &"])
+                f"> job.log 2>&1 &"], point="job.ssh",
+                policy=self.launch_retry_policy)
         return rc
 
     def send(self):
@@ -144,16 +203,26 @@ class Punchcard:
     """
 
     def __init__(self, manifest_path, secrets=(), poll_interval=5.0,
-                 dry_run=False):
+                 dry_run=False, read_retries=2):
         self.manifest_path = os.path.abspath(manifest_path)
         self.secrets = set(secrets)
         self.poll_interval = float(poll_interval)
         self.dry_run = dry_run
+        # a manifest mid-rewrite by its producer reads as missing or
+        # truncated JSON — transient, absorbed here instead of killing
+        # the poll daemon (ValueError covers json.JSONDecodeError)
+        self.read_policy = RetryPolicy(
+            attempts=int(read_retries) + 1, backoff=0.1, jitter=0.1,
+            retryable=(OSError, ValueError))
         self.executed = []
 
     def read_manifest(self):
-        with open(self.manifest_path) as f:
-            return json.load(f)
+        def _read():
+            fault_point("punchcard.read_manifest")
+            with open(self.manifest_path) as f:
+                return json.load(f)
+
+        return self.read_policy.call(_read)
 
     def pending_jobs(self):
         jobs = []

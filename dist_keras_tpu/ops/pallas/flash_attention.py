@@ -57,6 +57,7 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 from dist_keras_tpu.ops.attention import attention_with_lse as _ref_with_lse
+from dist_keras_tpu.utils import jax_compat
 
 _NEG_INF = -1e30
 
@@ -90,7 +91,7 @@ def _sds(shape, dtype, like):
     kernels compose with shard_map(check_vma=True) — ring attention calls
     them with the seq axis bound (vma is how jax tracks which mesh axes a
     value varies over inside shard_map)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = getattr(jax_compat.typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

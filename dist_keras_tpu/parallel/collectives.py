@@ -16,6 +16,7 @@ import jax
 from jax import lax
 
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
+from dist_keras_tpu.utils import jax_compat
 
 
 def tree_psum(tree, axis=WORKER_AXIS):
@@ -69,10 +70,10 @@ def tree_pvary(tree, axis=WORKER_AXIS):
     updates genuinely local; only explicit collectives then cross workers.
     """
     def _pvary(x):
-        vma = getattr(jax.typeof(x), "vma", frozenset())
+        vma = getattr(jax_compat.typeof(x), "vma", frozenset())
         if axis in vma:  # already varying: pcast would reject
             return x
-        return lax.pcast(x, (axis,), to="varying")
+        return jax_compat.pvary_cast(x, (axis,))
 
     return jax.tree.map(_pvary, tree)
 
@@ -82,4 +83,4 @@ def axis_index(axis=WORKER_AXIS):
 
 
 def axis_size(axis=WORKER_AXIS):
-    return lax.axis_size(axis)
+    return jax_compat.axis_size(axis)

@@ -31,6 +31,7 @@ import optax
 from jax import lax
 
 from dist_keras_tpu.models.layers import glorot_uniform
+from dist_keras_tpu.utils import jax_compat
 
 EXPERT_AXIS = "experts"
 
@@ -114,7 +115,7 @@ def switch_moe_ep(params, x, axis=EXPERT_AXIS, capacity_factor=1.25,
 
     -> (out (N_local, d), aux_loss local mean-contribution).
     """
-    ep = lax.axis_size(axis)
+    ep = jax_compat.axis_size(axis)
     e_local = params["w1"].shape[0]
     num_experts = ep * e_local
     n = x.shape[0]
@@ -230,10 +231,7 @@ def make_moe_ep_train_step(mesh, cfg, optimizer=None, aux_weight=1e-2,
         layer_norm as _ln,
     )
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from dist_keras_tpu.utils.jax_compat import shard_map
 
     if not cfg.get("moe_experts", 0):
         raise ValueError("make_moe_ep_train_step needs moe_experts > 0")

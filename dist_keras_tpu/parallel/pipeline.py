@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dist_keras_tpu.utils import jax_compat
+
 PIPE_AXIS = "stages"
 
 
@@ -61,29 +63,32 @@ def _pcast_like(tree, types):
     axes than the unmodified carry)."""
     def widen(val, ty):
         want = getattr(ty, "vma", frozenset()) or frozenset()
-        have = getattr(jax.typeof(val), "vma", frozenset()) or frozenset()
+        have = getattr(jax_compat.typeof(val), "vma", frozenset()) \
+            or frozenset()
         extra = tuple(want - have)
         if extra:
-            try:
-                val = lax.pcast(val, extra, to="varying")
-            except (AttributeError, TypeError):  # pre-pcast jax
-                val = lax.pvary(val, extra)
+            val = jax_compat.pvary_cast(val, extra)
         return val
 
     return jax.tree.map(widen, tree, types)
 
 
-def _grow_carry_vma(step_carry, carry0):
+def _grow_carry_vma(step_carry, carry0, max_rounds=None):
     """Promote each carry leaf's varying-axes (vma) set to the fixed
     point implied by one application of the scan body — so the carry
     type is stable under shard_map's check_vma on ANY mesh the caller
     composed around the pipe axis.  vma sets only grow and are bounded
     by the mesh's axis names, so the fixed point arrives in at most
-    #axes+1 rounds; a mesh with more axes than the round bound gets a
-    clear error instead of shard_map's opaque vma mismatch."""
-    # bound = #axes + 1 (one confirming round past the last widening);
-    # 10 covers meshes up to rank 9, far past any practical composition
-    max_rounds = 10
+    #axes+1 PER LEAF — but widening propagates one carry-hop per round,
+    so a deep leaf-to-leaf dependency chain can need more rounds than
+    #axes+1 overall.
+
+    ``max_rounds``: threaded down from the pipeline entry points —
+    ``make_pp_train_step`` derives ``max(10, len(mesh.axis_names)+1)``
+    from its mesh; direct engine callers can pass their own.  The
+    default 10 covers every practical composition."""
+    if max_rounds is None:
+        max_rounds = 10
     for _ in range(max_rounds):
         out = jax.eval_shape(step_carry, carry0)
         changed = False
@@ -91,10 +96,11 @@ def _grow_carry_vma(step_carry, carry0):
         def widen(init, sds):
             nonlocal changed
             want = getattr(sds, "vma", frozenset()) or frozenset()
-            have = getattr(jax.typeof(init), "vma", frozenset()) \
+            have = getattr(jax_compat.typeof(init), "vma", frozenset()) \
                 or frozenset()
-            for ax in want - have:
-                init = lax.pcast(init, (ax,), to="varying")
+            extra = tuple(want - have)
+            if extra:
+                init = jax_compat.pvary_cast(init, extra)
                 changed = True
             return init
 
@@ -103,8 +109,11 @@ def _grow_carry_vma(step_carry, carry0):
             return carry0
     raise ValueError(
         f"pipeline scan carry varying-axes sets did not reach a fixed "
-        f"point within {max_rounds} widening rounds — mesh has more "
-        f"axes than the bound; raise max_rounds in _grow_carry_vma")
+        f"point within {max_rounds} widening rounds; pass a larger "
+        f"max_rounds to the pipeline entry point (pipeline_1f1b / "
+        f"pipeline_interleaved_1f1b / pp_transformer_1f1b_grads — "
+        f"make_pp_train_step derives max(10, len(mesh.axis_names)+1) "
+        f"from its mesh automatically)")
 
 
 def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,
@@ -129,7 +138,7 @@ def gpipe_apply(stage_fn, stage_params, x, num_microbatches, axis=PIPE_AXIS,
     (leaves ``(M, ...)``).  Valid on every device via a psum over the
     stage axis.
     """
-    p = lax.axis_size(axis)
+    p = jax_compat.axis_size(axis)
     idx = lax.axis_index(axis)
     m = num_microbatches
     b = jax.tree.leaves(x)[0].shape[0]
@@ -239,7 +248,7 @@ def interleaved_gpipe_apply(stage_fn, chunk_params, x, num_microbatches,
     Backward is plain autodiff (scan + ring ppermute transpose cleanly),
     i.e. GPipe activation memory.
     """
-    p = lax.axis_size(axis)
+    p = jax_compat.axis_size(axis)
     idx = lax.axis_index(axis)
     m = num_microbatches
     v = int(virtual)
@@ -394,7 +403,8 @@ def pp_transformer_interleaved_apply(params, chunk_blocks, x, cfg,
 # 1F1B: memory-bounded interleaved schedule with a manual backward
 # ---------------------------------------------------------------------------
 def pipeline_1f1b(stage_fn, stage_params, h, num_microbatches, last_fn,
-                  axis=PIPE_AXIS, aux_ct=0.0, first_fn=None):
+                  axis=PIPE_AXIS, aux_ct=0.0, first_fn=None,
+                  max_rounds=None):
     """1F1B pipeline: forward AND backward in one interleaved schedule —
     call INSIDE shard_map with ``axis`` bound.
 
@@ -453,7 +463,7 @@ def pipeline_1f1b(stage_fn, stage_params, h, num_microbatches, last_fn,
     extras (replicated — nonzero contributions come only from the last /
     first stage respectively).
     """
-    p = lax.axis_size(axis)
+    p = jax_compat.axis_size(axis)
     idx = lax.axis_index(axis)
     m = num_microbatches
     b = h.shape[0]
@@ -516,7 +526,7 @@ def pipeline_1f1b(stage_fn, stage_params, h, num_microbatches, last_fn,
         # aux primal (stage_fns may return either an invariant constant
         # or a varying router loss)
         aux_cot = _pcast_like(jnp.asarray(aux_ct, aux2.dtype),
-                              jax.typeof(aux2))
+                              jax_compat.typeof(aux2))
         dparams, dx = vjp_fn((dh_in, aux_cot))
         gacc = jax.tree.map(
             lambda g, d: g + jnp.where(bvalid, d, jnp.zeros_like(d)),
@@ -556,7 +566,8 @@ def pipeline_1f1b(stage_fn, stage_params, h, num_microbatches, last_fn,
     # the invariant->varying promotion into a psum over workers, which
     # is exactly the DP gradient reduction).  Grow each leaf's
     # varying-axes set to the fixed point one tick implies.
-    carry0 = _grow_carry_vma(lambda c: tick(c, jnp.int32(0))[0], carry0)
+    carry0 = _grow_carry_vma(lambda c: tick(c, jnp.int32(0))[0], carry0,
+                             max_rounds)
     carry, _ = lax.scan(tick, carry0, jnp.arange(m + 2 * p - 2))
     (_, _, _, gacc, loss_acc, aux_acc, extras_acc, fextras_acc) = carry
 
@@ -603,7 +614,7 @@ def interleaved_1f1b_stash_entries(p, v, m):
 
 def pipeline_interleaved_1f1b(stage_fn, chunk_params, h, num_microbatches,
                               virtual, last_fn, axis=PIPE_AXIS,
-                              aux_ct=0.0, first_fn=None):
+                              aux_ct=0.0, first_fn=None, max_rounds=None):
     """Interleaved-virtual-stage 1F1B: Megatron-complete PP — the
     ``interleaved_gpipe_apply`` ring schedule (v non-contiguous chunks
     per device, bubble cut v-fold) COMBINED with ``pipeline_1f1b``'s
@@ -639,7 +650,7 @@ def pipeline_interleaved_1f1b(stage_fn, chunk_params, h, num_microbatches,
     aux_ct / returns: exactly as :func:`pipeline_1f1b`, except
     ``stage_grads`` has the (v, ...) chunk leading axis.
     """
-    p = lax.axis_size(axis)
+    p = jax_compat.axis_size(axis)
     idx = lax.axis_index(axis)
     m = num_microbatches
     v = int(virtual)
@@ -746,7 +757,7 @@ def pipeline_interleaved_1f1b(stage_fn, chunk_params, h, num_microbatches,
             (y2, aux2), vjp_fn = jax.vjp(
                 lambda pc, xx: stage_fn(pc, xx), params_c, x_st)
             aux_cot = _pcast_like(jnp.asarray(aux_ct, aux2.dtype),
-                                  jax.typeof(aux2))
+                                  jax_compat.typeof(aux2))
             dparams, dx = vjp_fn((dh_in, aux_cot))
             # accumulate into this chunk's grad slot
             cslot = jnp.clip(c_b, 0, v - 1)
@@ -800,7 +811,8 @@ def pipeline_interleaved_1f1b(stage_fn, chunk_params, h, num_microbatches,
                      fextras_shape),                          # first extras
     )
     carry0 = tree_pvary(carry0, axis)
-    carry0 = _grow_carry_vma(lambda c: tick(c, jnp.int32(0))[0], carry0)
+    carry0 = _grow_carry_vma(lambda c: tick(c, jnp.int32(0))[0], carry0,
+                             max_rounds)
     ticks = v * m + v * p + p - 2
     carry, _ = lax.scan(tick, carry0, jnp.arange(ticks))
     (_, _, _, gacc, loss_acc, aux_acc, extras_acc, fextras_acc) = carry
@@ -893,7 +905,8 @@ def pp_transformer_apply(params, stacked_blocks, x, cfg, num_microbatches,
 def pp_transformer_1f1b_grads(params, stacked_blocks, x, y, cfg,
                               num_microbatches, causal=False,
                               axis=PIPE_AXIS, attn_fn=None,
-                              aux_weight=1e-2, virtual=1):
+                              aux_weight=1e-2, virtual=1,
+                              max_rounds=None):
     """1F1B fwd+bwd of the transformer — call inside shard_map.
 
     Computes the same objective as the MoE/TP train steps —
@@ -992,11 +1005,13 @@ def pp_transformer_1f1b_grads(params, stacked_blocks, x, y, cfg,
         loss, aux_sum, block_grads, (d_lnf, d_head), (d_proj, d_pos) = (
             pipeline_interleaved_1f1b(
                 stage_fn, stacked_blocks, h, m, int(virtual), last_fn,
-                axis, aux_ct=aux_weight / m, first_fn=first_fn))
+                axis, aux_ct=aux_weight / m, first_fn=first_fn,
+                max_rounds=max_rounds))
     else:
         loss, aux_sum, block_grads, (d_lnf, d_head), (d_proj, d_pos) = (
             pipeline_1f1b(stage_fn, stacked_blocks, h, m, last_fn, axis,
-                          aux_ct=aux_weight / m, first_fn=first_fn))
+                          aux_ct=aux_weight / m, first_fn=first_fn,
+                          max_rounds=max_rounds))
     rest_grads = {"proj": d_proj, "pos": d_pos, "ln_f": d_lnf,
                   "head": d_head}
     return loss, aux_sum / m, rest_grads, block_grads
@@ -1065,19 +1080,36 @@ def make_pp_train_step(mesh, cfg, num_microbatches, optimizer=None,
             eng_blocks = blocks
         loss, aux, rest_g, block_g = pp_transformer_1f1b_grads(
             rest, eng_blocks, x, y, cfg, num_microbatches, causal=causal,
-            attn_fn=attn_fn, aux_weight=aux_weight, virtual=v)
+            attn_fn=attn_fn, aux_weight=aux_weight, virtual=v,
+            # derived from the mesh so no user ever edits a
+            # library-local bound; floored at the historical 10 because
+            # widening propagates one carry-hop per round, so a deep
+            # leaf-to-leaf chain can need more rounds than #axes+1
+            max_rounds=max(10, len(mesh.axis_names) + 1))
         if v > 1:
             block_g = jax.tree.map(lambda g: g[None], block_g)
         if dp:
-            # params are worker-INVARIANT, data worker-varying: AD's
-            # implicit invariant->varying promotion transposes into a
-            # psum over workers, so the grads arrive already SUMMED —
-            # scale to the mean instead of collecting again
-            n = mesh.shape[WORKER_AXIS]
             loss = lax.pmean(loss, WORKER_AXIS)
             aux = lax.pmean(aux, WORKER_AXIS)
-            rest_g = jax.tree.map(lambda g: g / n, rest_g)
-            block_g = jax.tree.map(lambda g: g / n, block_g)
+            if jax_compat.HAS_VMA:
+                # params are worker-INVARIANT, data worker-varying: AD's
+                # implicit invariant->varying promotion transposes into
+                # a psum over workers, so the grads arrive already
+                # SUMMED — scale to the mean instead of collecting again
+                n = mesh.shape[WORKER_AXIS]
+                rest_g = jax.tree.map(lambda g: g / n, rest_g)
+                block_g = jax.tree.map(lambda g: g / n, block_g)
+            else:
+                # pre-vma jax runs this program with check_rep=False
+                # (the static inferencer rejects it, see
+                # jax_compat.shard_map), which also drops that implicit
+                # transpose psum: each worker column holds only ITS
+                # local-data gradient — reduce explicitly or the
+                # columns silently drift apart
+                rest_g = jax.tree.map(
+                    lambda g: lax.pmean(g, WORKER_AXIS), rest_g)
+                block_g = jax.tree.map(
+                    lambda g: lax.pmean(g, WORKER_AXIS), block_g)
         u_r, opt_rest = tx.update(rest_g, opt_rest, rest)
         rest = optax.apply_updates(rest, u_r)
         u_b, opt_blocks = tx.update(block_g, opt_blocks, blocks)
@@ -1113,10 +1145,9 @@ def make_pp_train_step(mesh, cfg, num_microbatches, optimizer=None,
     def step_factory(rest, blocks, opt_rest, opt_blocks):
         rs, bs, ors, obs, xs_spec = pp_step_specs(
             rest, blocks, opt_rest, opt_blocks)
-        try:
-            from jax import shard_map
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+        # jax_compat.shard_map: composed-mesh (PP x DP) programs fail
+        # pre-vma jax's static replication inference — see the shim
+        from dist_keras_tpu.utils.jax_compat import shard_map
 
         return jax.jit(shard_map(
             body, mesh=mesh,

@@ -39,7 +39,13 @@ from dist_keras_tpu.models.transformer import (
 )
 from dist_keras_tpu.ops.attention import ring_attention
 from dist_keras_tpu.parallel.mesh import MODEL_AXIS, SEQ_AXIS, WORKER_AXIS, grid_mesh
+from dist_keras_tpu.utils import jax_compat
 
+# deliberately the raw import, NOT jax_compat.shard_map: that shim
+# disables check_rep on pre-vma jax, but this module's programs (the
+# TP forward, and the vma-path train step) pass the static replication
+# check and should keep it — the pre-vma TRAIN path instead
+# differentiates THROUGH shard_map (see make_tp_train_step)
 try:
     from jax import shard_map
 except ImportError:  # older jax
@@ -168,26 +174,26 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
             "make_moe_ep_train_step (expert parallelism)")
     tx = optimizer or optax.adam(1e-3)
 
+    def local_loss(p, x, y):
+        """Per-device loss on this device's (worker, seq) data block —
+        the quantity both factory paths differentiate."""
+        if compute_dtype is not None:
+            from dist_keras_tpu.utils.pytree import tree_cast
+
+            p = tree_cast(p, compute_dtype)
+            x = x.astype(compute_dtype)
+        logits = tp_transformer_forward(p, x, cfg, causal=causal,
+                                        remat=remat)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(
+            logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+        # mean over the data-parallel axis -> AD emits the grad psums
+        return lax.pmean(nll, WORKER_AXIS)
+
     def body(params, opt_state, x, y):
         # x local block: (B/workers, T/seq, input_dim); y: (B/workers,)
-
-        def loss_fn(p):
-            if compute_dtype is not None:
-                from dist_keras_tpu.utils.pytree import tree_cast
-
-                p = tree_cast(p, compute_dtype)
-                xc = x.astype(compute_dtype)
-            else:
-                xc = x
-            logits = tp_transformer_forward(p, xc, cfg, causal=causal,
-                                            remat=remat)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            nll = -jnp.take_along_axis(
-                logp, y[:, None].astype(jnp.int32), axis=-1).mean()
-            # mean over the data-parallel axis -> AD emits the grad psums
-            return lax.pmean(nll, WORKER_AXIS)
-
-        loss_val, grads = jax.value_and_grad(loss_fn)(params)
+        loss_val, grads = jax.value_and_grad(
+            lambda p: local_loss(p, x, y))(params)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt, loss_val
@@ -199,11 +205,32 @@ def make_tp_train_step(mesh, cfg, optimizer=None, loss="softmax_xent",
 
     def step_fn_factory(params, opt_state):
         pspecs, ospecs, data_x, data_y = tp_step_specs(params, opt_state)
-        return jax.jit(shard_map(
-            body, mesh=mesh,
-            in_specs=(pspecs, ospecs, data_x, data_y),
-            out_specs=(pspecs, ospecs, P()),
-        ))
+        if jax_compat.HAS_VMA:
+            # grad INSIDE shard_map: the vma-aware transpose inserts the
+            # cross-axis psums and proves the output replication
+            return jax.jit(shard_map(
+                body, mesh=mesh,
+                in_specs=(pspecs, ospecs, data_x, data_y),
+                out_specs=(pspecs, ospecs, P()),
+            ))
+        # Pre-vma jax: its rep machinery can neither prove the updated
+        # params' replication (check_rep=True rejects the program) nor
+        # transpose the grad correctly with the check disabled (measured
+        # against the single-device oracle).  Differentiate THROUGH the
+        # shard_map primitive instead — its transpose derives the exact
+        # psums from the in/out specs — and update outside it under the
+        # same jit (GSPMD keeps the leaves sharded per spec).
+        fwd = shard_map(local_loss, mesh=mesh,
+                        in_specs=(pspecs, data_x, data_y), out_specs=P())
+
+        def step(params, opt_state, x, y):
+            loss_val, grads = jax.value_and_grad(
+                lambda p: fwd(p, x, y))(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, loss_val
+
+        return jax.jit(step)
 
     return step_fn_factory, init_fn
 

@@ -1,0 +1,42 @@
+"""Resilience subsystem: fault injection, retry/backoff, preemption-safe
+shutdown, and NaN guards.
+
+The reference inherited its failure story from Spark (failed partitions
+re-run, the driver poll loop just waits); this TPU-native port builds the
+equivalent by design and proves it with deterministic fault injection:
+
+- :mod:`~dist_keras_tpu.resilience.faults` — named fault points
+  (``fault_point("checkpoint.save")``, ``"job.rsync"``, ``"stream.fetch"``,
+  ``"step.loss"``) that raise/corrupt on a scheduled call count, armed in
+  code or via ``DK_FAULTS``.
+- :mod:`~dist_keras_tpu.resilience.retry` — ``retry``/``RetryPolicy``
+  with exponential backoff, deterministic jitter and an overall deadline;
+  applied to rsync/ssh (``launch.Job``), manifest polls
+  (``launch.Punchcard``), checkpoint writes and stream fetches.
+- :mod:`~dist_keras_tpu.resilience.preemption` — SIGTERM/SIGINT →
+  checkpoint at the next chunk boundary → exit ``128+signum``
+  (``Trainer(handle_preemption=True)``).
+- :mod:`~dist_keras_tpu.resilience.guards` — NaN/Inf sentinel over every
+  fetched loss with per-trainer policy ``"raise" | "skip" | "halt"``,
+  counted in ``trainer.metrics``.
+
+See the README "Failure semantics" section for the retried / resumed /
+fatal taxonomy.
+"""
+
+from dist_keras_tpu.resilience import faults, guards, preemption, retry
+from dist_keras_tpu.resilience.faults import (
+    FaultInjected,
+    armed,
+    fault_point,
+    inject,
+)
+from dist_keras_tpu.resilience.guards import NonFiniteLossError
+from dist_keras_tpu.resilience.preemption import Preempted
+from dist_keras_tpu.resilience.retry import RetryPolicy, retry_call
+
+__all__ = [
+    "faults", "guards", "preemption", "retry",
+    "FaultInjected", "armed", "fault_point", "inject",
+    "NonFiniteLossError", "Preempted", "RetryPolicy", "retry_call",
+]

@@ -1,0 +1,250 @@
+"""Deterministic fault injection — named fault points on real failure paths.
+
+The reference tolerated worker loss for free because Spark re-ran failed
+partitions; this TPU-native port has to *prove* its failure story instead
+of hoping, and proof needs faults that reproduce exactly.  Every
+recoverable I/O seam in the framework passes through a named
+:func:`fault_point`:
+
+- ``"checkpoint.save"``  — between a checkpoint's tmp-dir write and its
+  atomic rename (``checkpoint.Checkpointer``): raising here IS the
+  mid-write kill.
+- ``"job.rsync"`` / ``"job.ssh"`` — around each per-host command in
+  ``launch.Job`` (the value is the return code, so a replace-fault
+  simulates a flaky transport without a cluster).
+- ``"punchcard.read_manifest"`` — before each manifest read (a torn
+  concurrent write is a truncated-JSON ValueError).
+- ``"stream.fetch"`` — before each ``StreamSource.get`` in
+  ``StreamingPredictor``.
+- ``"step.loss"`` — over each fetched host loss array in the trainers'
+  ``ChunkRunner`` (a corrupt-fault plants a NaN to exercise the
+  ``nan_policy`` sentinel without poisoning device math).
+
+Faults are scheduled on the point's CALL COUNT (0-based), so a test kills
+exactly the Nth save or fails exactly the first two rsyncs — no timing, no
+flakes.  Arm programmatically with :func:`inject` (or the ``armed``
+context manager), or via the ``DK_FAULTS`` environment variable so
+subprocess tests inherit the schedule:
+
+    DK_FAULTS="checkpoint.save@1;job.rsync@0x2:action=replace,value=30"
+
+Grammar per semicolon-separated entry: ``point[@at][xN][:k=v,...]`` with
+keys ``action`` (raise|corrupt|replace), ``exc`` (FaultInjected, OSError,
+IOError, ValueError, RuntimeError, ConnectionError, TimeoutError) and
+``value`` (float for replace).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+
+class FaultInjected(Exception):
+    """Raised by an armed fault point.
+
+    Deliberately NOT an ``OSError`` subclass: default retry policies
+    treat it as permanent, so a fault that simulates a process kill is
+    not silently retried away.  Arm with ``exc=OSError`` to exercise a
+    retry path instead.
+    """
+
+
+_MISSING = object()
+_EXC_NAMES = {
+    "FaultInjected": FaultInjected,
+    "OSError": OSError,
+    "IOError": IOError,
+    "ValueError": ValueError,
+    "RuntimeError": RuntimeError,
+    "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+}
+
+_lock = threading.RLock()
+_specs = {}       # point name -> [FaultSpec]
+_counts = {}      # point name -> calls so far
+_env_loaded = False
+
+
+class FaultSpec:
+    """One armed fault: fire on calls ``at .. at+times-1`` of a point."""
+
+    def __init__(self, point, at=0, times=1, action="raise", exc=None,
+                 value=None):
+        if action not in ("raise", "corrupt", "replace"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.point = str(point)
+        self.at = int(at)
+        self.times = int(times)
+        self.action = action
+        self.exc = exc or FaultInjected
+        self.value = value
+        self.fired = 0  # introspection: how many times this spec fired
+
+    def covers(self, count):
+        return self.at <= count < self.at + self.times
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"FaultSpec({self.point!r}, at={self.at}, "
+                f"times={self.times}, action={self.action!r})")
+
+
+def inject(point, at=0, times=1, action="raise", exc=None, value=None):
+    """Arm ``point`` to fire on its ``at``-th .. ``at+times-1``-th call
+    COUNTED FROM NOW (relative to arming, so a test arms "the next save"
+    regardless of how many saves ran earlier in the process; env-armed
+    specs load before the first call, where relative == absolute).
+
+    ``action``: ``"raise"`` raises ``exc`` (default :class:`FaultInjected`);
+    ``"corrupt"`` returns a NaN-poisoned copy of the value passed to
+    :func:`fault_point`; ``"replace"`` returns ``value`` instead of it.
+    Returns the :class:`FaultSpec` (pass to :func:`disarm`, or
+    :func:`clear` everything).
+    """
+    spec = FaultSpec(point, at=at, times=times, action=action, exc=exc,
+                     value=value)
+    with _lock:
+        spec.at += _counts.get(spec.point, 0)
+        _specs.setdefault(spec.point, []).append(spec)
+    return spec
+
+
+def disarm(spec):
+    with _lock:
+        lst = _specs.get(spec.point, [])
+        if spec in lst:
+            lst.remove(spec)
+
+
+def clear():
+    """Disarm every fault and reset every call counter (also forgets any
+    ``DK_FAULTS`` env schedule until the next explicit :func:`load_env`)."""
+    global _env_loaded
+    with _lock:
+        _specs.clear()
+        _counts.clear()
+        _env_loaded = True  # an explicit clear overrides the env schedule
+
+
+def call_count(point):
+    """How many times ``point`` has been passed so far (armed or not)."""
+    with _lock:
+        return _counts.get(point, 0)
+
+
+class armed:
+    """Context manager: arm a fault for the block, disarm after.
+
+    >>> with faults.armed("checkpoint.save", at=0):
+    ...     ckptr.save(1, state)   # raises FaultInjected mid-write
+    """
+
+    def __init__(self, point, **kw):
+        self._args = (point, kw)
+        self.spec = None
+
+    def __enter__(self):
+        point, kw = self._args
+        self.spec = inject(point, **kw)
+        return self.spec
+
+    def __exit__(self, *exc):
+        disarm(self.spec)
+        return False
+
+
+_ENV_ENTRY_RE = re.compile(
+    r"^(?P<point>.+?)(?:@(?P<at>\d+)(?:x(?P<times>\d+))?)?$")
+
+
+def _parse_env_entry(entry):
+    entry = entry.strip()
+    if not entry:
+        return None
+    opts = {}
+    if ":" in entry:
+        entry, _, raw = entry.partition(":")
+        for kv in raw.split(","):
+            k, _, v = kv.partition("=")
+            opts[k.strip()] = v.strip()
+    m = _ENV_ENTRY_RE.match(entry)
+    # fail LOUDLY at parse time, naming the entry — a malformed schedule
+    # surfacing lazily from the first fault_point call deep inside
+    # training would be much harder to trace back to the env var
+    if m is None or not entry or m.group("point").endswith("@"):
+        raise ValueError(
+            f"malformed DK_FAULTS entry {entry!r}: expected "
+            "point[@at[xN]][:k=v,...]")
+    exc = _EXC_NAMES.get(opts.get("exc", "FaultInjected"), FaultInjected)
+    value = opts.get("value")
+    if value is not None:
+        value = float(value)
+    return FaultSpec(m.group("point"), at=int(m.group("at") or 0),
+                     times=int(m.group("times") or 1),
+                     action=opts.get("action", "raise"), exc=exc,
+                     value=value)
+
+
+def load_env(var="DK_FAULTS", force=False):
+    """Arm the schedule in ``$DK_FAULTS`` (idempotent per process; called
+    lazily by the first :func:`fault_point`; ``force=True`` re-reads the
+    env after a :func:`clear`)."""
+    global _env_loaded
+    with _lock:
+        if _env_loaded and not force:
+            return
+        _env_loaded = True
+        for entry in os.environ.get(var, "").split(";"):
+            spec = _parse_env_entry(entry)
+            if spec is not None:
+                _specs.setdefault(spec.point, []).append(spec)
+
+
+def _corrupt(value):
+    """Deterministically poison ``value`` with NaN (first element of an
+    array; the whole thing for a scalar)."""
+    import numpy as np
+
+    arr = np.array(value, copy=True)
+    if arr.ndim == 0:
+        return type(value)(float("nan")) if isinstance(value, float) \
+            else np.asarray(float("nan"), dtype=arr.dtype)
+    flat = arr.reshape(-1)
+    flat[0] = float("nan")
+    return arr
+
+
+def fault_point(name, value=_MISSING):
+    """Declare a named fault point; returns ``value`` (or None) unless an
+    armed spec covers this invocation.
+
+    Zero-overhead-by-default contract: unarmed, this is one dict lookup
+    and an int increment — safe on warm paths like the per-chunk loss
+    retire (NOT the per-step device loop, which is compiled and cannot
+    host a Python hook).
+    """
+    with _lock:
+        load_env()
+        count = _counts.get(name, 0)
+        _counts[name] = count + 1
+        spec = None
+        for s in _specs.get(name, ()):
+            if s.covers(count):
+                spec = s
+                break
+    if spec is None:
+        return None if value is _MISSING else value
+    spec.fired += 1
+    if spec.action == "raise":
+        raise spec.exc(
+            f"fault injected at point {name!r} (call #{count})")
+    if spec.action == "replace":
+        return spec.value
+    # corrupt
+    if value is _MISSING:
+        raise ValueError(
+            f"fault point {name!r} armed with action='corrupt' but the "
+            "call site passes no value to corrupt")
+    return _corrupt(value)

@@ -1,0 +1,141 @@
+"""Preemption-safe shutdown: catch SIGTERM/SIGINT, checkpoint, exit 128+N.
+
+TPU pods are preemptible: the scheduler sends SIGTERM and gives the
+process a grace window.  A trainer with ``handle_preemption=True``
+installs these handlers around its dispatch loop; the handler only sets a
+flag (async-signal-safe), the loop notices it at the next chunk boundary,
+saves a checkpoint of the full training state at that exact step, and
+raises :class:`Preempted` — a ``SystemExit`` subclass carrying the
+conventional ``128 + signum`` code, so an UNCAUGHT preemption exits the
+process with 143 (SIGTERM) / 130 (SIGINT) and an external scheduler can
+distinguish "preempted, restart with ``resume=True``" from success (0)
+or a real crash (1).  The companion bench driver exits ``128+signum`` the
+same way (``bench.py``), so the convention is uniform across the repo.
+
+Displaced handlers ESCALATE rather than chain: the first delivery only
+sets the flag (a previous handler that exits — bench.py's does — would
+otherwise kill the process before the boundary checkpoint); a second
+delivery hands the signal to the displaced disposition — a previous
+handler runs, SIG_DFL is reinstalled and the signal re-delivered — so
+"kill -TERM twice" still hard-exits even when the trainer is wedged in
+a blocking device fetch.  Flush-style
+handlers also fire on the graceful path because an uncaught
+:class:`Preempted` is a ``SystemExit`` — atexit hooks run on the way
+out.
+
+Single-host scope note: each process checkpoints its own step counter;
+COORDINATED multi-host preemption (all hosts agreeing on the save step
+before any of them exits) is an open ROADMAP item.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+
+class Preempted(SystemExit):
+    """Training was interrupted by a signal after a boundary checkpoint.
+
+    Subclasses ``SystemExit`` with ``code = 128 + signum``: uncaught, the
+    process exits with the scheduler-conventional code; tests catch it
+    like any exception.
+    """
+
+    def __init__(self, signum, saved_step=None):
+        self.signum = int(signum)
+        self.saved_step = saved_step  # units_done of the boundary save
+        super().__init__(128 + int(signum))
+
+    @property
+    def exit_code(self):
+        return self.code
+
+
+_lock = threading.Lock()  # guards install/restore bookkeeping ONLY —
+# the handler itself must stay lock-free: CPython dispatches handlers
+# re-entrantly in the main thread at bytecode boundaries, so a handler
+# blocking on a lock the interrupted code (or a nested handler) holds
+# would deadlock the process.  Plain reads/assignments are atomic under
+# the GIL, which is all the handler needs.
+_requested = None       # first delivered signum, or None
+_prev = {}              # signum -> previous handler (install/restore)
+
+
+def _handler(signum, frame):
+    global _requested
+    first = _requested is None
+    if first:
+        _requested = signum
+        # Escalation, not chaining: the FIRST delivery only sets the
+        # flag — a displaced handler that exits (bench.py's _on_signal
+        # calls os._exit(128+signum)) would otherwise kill the process
+        # before the loop reaches its boundary checkpoint, silently
+        # disabling the graceful window.  Flush-style handlers still
+        # fire on the graceful path: the uncaught Preempted is a
+        # SystemExit, so atexit hooks run on the way out.
+        return
+    # SECOND delivery: the grace period is over — escalate through the
+    # displaced disposition (a stuck run must stay killable by SIGTERM).
+    prev = _prev.get(signum)
+    if prev is signal.SIG_IGN:
+        return
+    if callable(prev) and prev is not signal.SIG_DFL:
+        prev(signum, frame)
+        return
+    # SIG_DFL (or unknown): reinstall the default and re-deliver so the
+    # OS-default action (terminate) actually happens
+    signal.signal(signum, signal.SIG_DFL)
+    import os
+
+    os.kill(os.getpid(), signum)
+
+
+def install(signals=(signal.SIGTERM, signal.SIGINT)):
+    """Install the graceful handlers.  Returns True when installed; False
+    from a non-main thread (signal handlers are main-thread-only — the
+    caller then simply runs without a graceful window).
+
+    A request already pending is PRESERVED, not reset: a SIGTERM that
+    landed between two trainer runs (after A's last boundary check,
+    before B installed) still preempts B at its first boundary — the
+    scheduler's grace clock is ticking regardless.  Code that
+    deliberately continues after catching :class:`Preempted` must call
+    :func:`clear` first."""
+    try:
+        for s in signals:
+            prev = signal.signal(s, _handler)
+            if prev is not _handler:  # re-install keeps the ORIGINAL prev
+                _prev[s] = prev
+    except ValueError:  # not the main thread
+        return False
+    return True
+
+
+def restore():
+    """Re-install the handlers that :func:`install` displaced."""
+    with _lock:
+        saved = dict(_prev)
+        _prev.clear()
+    for s, h in saved.items():
+        try:
+            signal.signal(s, h)
+        except (ValueError, TypeError):  # pragma: no cover
+            pass
+
+
+def requested():
+    """The first signal delivered since :func:`install`, or None."""
+    return _requested
+
+
+def request(signum=signal.SIGTERM):
+    """Simulate a delivery (tests / cooperative schedulers)."""
+    global _requested
+    if _requested is None:
+        _requested = int(signum)
+
+
+def clear():
+    global _requested
+    _requested = None

@@ -26,7 +26,6 @@ from dist_keras_tpu.trainers.chunking import (
     run_chunked,
     scan_units,
 )
-from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.sync import drain
 
 try:
@@ -61,8 +60,7 @@ class AveragingTrainer(DistributedTrainer):
         spe = xs.shape[1]
         total_t = self.num_epoch * spe
         mesh = self.mesh
-        step, opt_init = make_model_step(
-            model, loss_fn, tx, self.compute_dtype)
+        step, opt_init = self._make_step(model, loss_fn, tx)
         key = jax.random.PRNGKey(self.seed)
 
         def build_chunk(T, streamed=False):
@@ -194,7 +192,19 @@ class EnsembleTrainer(DistributedTrainer):
     trainer is gone — an ensemble whose data exceeds HBM streams
     through the two-buffer ChunkFeed like the rest of the family
     (reference property: an epoch never has to fit in executor memory,
-    workers.py:~60)."""
+    workers.py:~60).
+
+    ``get_history()`` shape contract (mirrors the windowed family's
+    convention, see ``Trainer.get_history``): a run whose executed span
+    covers WHOLE epochs returns ``(num_models, epochs,
+    steps_per_epoch)``; a run RESUMED mid-epoch (its partial first epoch
+    breaks the alignment) returns the flat ``(num_models, steps_run)``
+    layout instead.  Callers that index history per epoch should check
+    ``ndim``/the middle axis, or keep ``checkpoint_every`` in whole
+    epochs so every resume stays epoch-aligned.  The flat layout is
+    deliberate: padding the partial epoch would fabricate loss values,
+    and splitting it would misalign epoch indices against an
+    uninterrupted run's."""
 
     def __init__(self, keras_model, num_models=2, stream_chunk_steps=None,
                  max_resident_bytes=None, **kw):
@@ -256,8 +266,7 @@ class EnsembleTrainer(DistributedTrainer):
         xs, ys = _regroup(xs), _regroup(ys)  # (slots, steps, mps, ...)
         spe = xs.shape[1]
         total_t = self.num_epoch * spe
-        step, opt_init = make_model_step(
-            model, loss_fn, tx, self.compute_dtype)
+        step, opt_init = self._make_step(model, loss_fn, tx)
         key = jax.random.PRNGKey(self.seed)
 
         def build_chunk(T, streamed=False):

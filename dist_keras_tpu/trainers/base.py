@@ -33,7 +33,8 @@ class Trainer:
                  batch_size=32, num_epoch=1, seed=0, compute_dtype=None,
                  data_dtype=np.float32,
                  checkpoint_dir=None, checkpoint_every=None,
-                 max_checkpoints=3, resume=False, callbacks=None):
+                 max_checkpoints=3, resume=False, callbacks=None,
+                 nan_policy="raise", handle_preemption=False):
         self.serialized_model = serialize_model(keras_model)
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -64,6 +65,23 @@ class Trainer:
         self.max_checkpoints = int(max_checkpoints)
         self.resume = bool(resume)
         self.callbacks = list(callbacks or [])
+        # ---- resilience (round 6) ----
+        # nan_policy: what the loss sentinel does on NaN/Inf —
+        # "raise" (default: abort BEFORE the boundary checkpoint, so the
+        # last save predates the divergence), "skip" (device-side guard:
+        # a non-finite step keeps the previous params/opt state), "halt"
+        # (stop dispatching at the boundary, return what trained), or
+        # None/"off" (count only).  Counted per epoch in
+        # metrics[...]["nonfinite_steps"] either way.
+        from dist_keras_tpu.resilience.guards import normalize_policy
+
+        self.nan_policy = normalize_policy(nan_policy)
+        # handle_preemption: install SIGTERM/SIGINT handlers around the
+        # dispatch loop; on delivery, checkpoint at the next chunk
+        # boundary and raise resilience.Preempted (exit code 128+signum)
+        self.handle_preemption = bool(handle_preemption)
+        self.nonfinite_steps = 0   # cumulative non-finite loss entries
+        self._nonfinite_emitted = 0
         self.metrics = []  # per-epoch {"epoch", "mean_loss", ...}
         self._checkpointer = None
         self.history = []
@@ -133,11 +151,14 @@ class Trainer:
         # count into the trace (epoch-scan) add it via _cache_extras;
         # trainers that loop epochs on the host must share executables
         # across different epoch counts.
+        # nan_policy="skip" compiles a different step (finite-guarded
+        # update); the other policies are host-side and share executables
         return (type(self).__name__,
                 self.serialized_model["model"],
                 _tok(self.loss), _tok(self.worker_optimizer),
                 tuple(sorted(self.optimizer_kwargs.items())),
                 str(self.compute_dtype),
+                self.nan_policy == "skip",
                 self._cache_extras())
 
     @staticmethod
@@ -254,15 +275,32 @@ class Trainer:
             self._last_ckpt_epoch = epochs_done
 
     def _emit_epoch_end(self, epochs_done, losses, seconds, samples):
-        """Record structured per-epoch metrics; fire callbacks."""
+        """Record structured per-epoch metrics; fire callbacks.
+
+        Under nan_policy="skip" — and ONLY there — ``mean_loss``
+        averages the finite losses: one exploding batch must not poison
+        the epoch's metric (and any loss-watching callback) after the
+        step itself was correctly skipped.  Every other policy keeps the
+        plain mean, so with the sentinel opted out (None) a divergence
+        still surfaces as a NaN mean_loss exactly as before round 6;
+        the non-finite count is reported alongside either way."""
+        arr = np.asarray(losses, dtype=np.float64)
+        if self.nan_policy == "skip" and arr.size:
+            arr = arr[np.isfinite(arr)]
         logs = {
             "epoch": epochs_done,
-            "mean_loss": float(np.mean(losses)) if np.size(losses) else
+            "mean_loss": float(np.mean(arr)) if arr.size else
             float("nan"),
             "seconds": float(seconds),
             "samples_per_sec": float(samples / seconds) if seconds > 0
             else float("nan"),
+            # non-finite loss entries seen since the previous emit (the
+            # NaN sentinel's per-epoch ledger; cumulative total lives on
+            # trainer.nonfinite_steps)
+            "nonfinite_steps": self.nonfinite_steps
+            - self._nonfinite_emitted,
         }
+        self._nonfinite_emitted = self.nonfinite_steps
         self.metrics.append(logs)
         for cb in self.callbacks:
             hook = getattr(cb, "on_epoch_end", cb)
@@ -277,6 +315,16 @@ class Trainer:
         model = self._fresh_model()
         return (model, get_loss(self.loss),
                 get_optimizer(self.worker_optimizer, **self.optimizer_kwargs))
+
+    def _make_step(self, model, loss_fn, tx):
+        """``make_model_step`` with this trainer's NaN policy compiled in
+        — the single seam every trainer family builds its step through,
+        so ``nan_policy="skip"`` guards all of them identically."""
+        from dist_keras_tpu.trainers.step import make_model_step
+
+        return make_model_step(
+            model, loss_fn, tx, self.compute_dtype,
+            skip_nonfinite=(self.nan_policy == "skip"))
 
     def _finalize(self, params, history):
         """Install trained params into a fresh model; record history."""

@@ -25,6 +25,10 @@ import time
 
 import numpy as np
 
+from dist_keras_tpu.resilience import preemption
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.resilience.guards import check_losses
+from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.utils.sync import drain
 
 
@@ -261,34 +265,76 @@ class ChunkRunner:
             self.tr._checkpointer_or_none().save(units_done, state_fn())
             self.tr._last_ckpt_epoch = units_done
 
+    def _preempt_save(self, units_done, state_fn):
+        """Boundary checkpoint on a delivered SIGTERM/SIGINT — saved
+        regardless of cadence (deduped against a save that already
+        landed at this unit), so the restart loses nothing.  The None
+        sentinel (vs the 0 default used by the cadence math) matters: a
+        fresh run preempted before any save still writes its unit-0
+        state, so ``Preempted.saved_step`` never claims a checkpoint
+        that does not exist."""
+        ckptr = self.tr._checkpointer_or_none()
+        if ckptr is None:
+            return None
+        if getattr(self.tr, "_last_ckpt_epoch", None) != units_done:
+            ckptr.save(units_done, state_fn())
+            self.tr._last_ckpt_epoch = units_done
+        return units_done
+
     def run(self, dispatch, sync_ref, state_fn, resident_data=()):
         tr = self.tr
         all_losses, acc_losses = [], []
         acc_dt, acc_samples = 0.0, 0
         units_done = self.start
+        self._halt = False  # set by the NaN sentinel under policy "halt"
         # pipelined in-flight chunks whose losses are not yet fetched
-        pending = []  # [(chunk_idx, device losses)]
+        pending = []  # [(chunk_idx, device losses, units when done)]
 
         def _retire_one():
             # the blocking fetch doubles as the backpressure barrier —
             # see the class docstring for why a drain + deferred fetch
             # is NOT cheaper here
-            j, lj = pending.pop(0)
+            j, lj, units_after = pending.pop(0)
             arr = np.asarray(self._fetch(lj))  # blocks until chunk j done
+            # deterministic NaN injection rides the fetched host array
+            # (device math untouched) — the nan_policy test hook
+            arr = fault_point("step.loss", value=arr)
             if self.feed is not None:
                 self.feed.release(j)
             all_losses.append(arr)
             acc_losses.append(arr)
+            # the sentinel: count NaN/Inf, apply the trainer's policy
+            # ("raise" aborts HERE — before any boundary save can
+            # persist post-divergence state; "halt" drains and stops)
+            if check_losses(tr, arr, units_done=units_after):
+                self._halt = True
 
+        # graceful preemption window: handlers only set a flag; the loop
+        # notices it at the next chunk boundary below
+        installed = tr.handle_preemption and preemption.install()
         tr.record_training_start()
         t_mark = time.time()
         try:
             for i, K in enumerate(self.plan):
+                sig = (preemption.requested()
+                       if tr.handle_preemption else None)
+                if sig is not None:
+                    # checkpoint at the boundary, then exit 128+signum
+                    # (Preempted is a SystemExit) so the scheduler
+                    # restarts with resume=True.  The drain can trip the
+                    # NaN sentinel ("raise" aborts inside _retire_one;
+                    # "halt" sets the flag) — a halted run's diverged
+                    # state must NOT be persisted here either.
+                    while pending:
+                        _retire_one()
+                    saved = (None if self._halt
+                             else self._preempt_save(units_done, state_fn))
+                    raise Preempted(sig, saved_step=saved)
                 data = (self.feed.get(i) if self.feed is not None
                         else resident_data)
                 losses = dispatch(i, K, units_done, data)
-                pending.append((i, losses))
                 units_done += K
+                pending.append((i, losses, units_done))
                 if self.feed is not None:
                     # retire the previous chunk BEFORE prefetching the
                     # next: at most two chunks' data is ever
@@ -299,7 +345,8 @@ class ChunkRunner:
                     self.feed.prefetch(i + 1)
                 boundary = (units_done % self.per_epoch == 0
                             or i == len(self.plan) - 1
-                            or self._ckpt_due(units_done))
+                            or self._ckpt_due(units_done)
+                            or self._halt)
                 acc_samples += self.samples_per_unit * K
                 if not boundary:
                     continue
@@ -310,19 +357,35 @@ class ChunkRunner:
                 while pending:
                     _retire_one()
                 # save BEFORE user callbacks run: a callback that dies
-                # (preemption simulation) must not lose the chunk
-                self._maybe_ckpt(units_done, state_fn)
+                # (preemption simulation) must not lose the chunk — but
+                # NEVER persist a halted (diverged) run's state
+                if not self._halt:
+                    self._maybe_ckpt(units_done, state_fn)
                 if units_done % self.per_epoch == 0:
                     tr._emit_epoch_end(
                         units_done // self.per_epoch,
                         np.concatenate(acc_losses, axis=1),
                         acc_dt, acc_samples)
                     acc_losses, acc_dt, acc_samples = [], 0.0, 0
+                if self._halt:
+                    # halting mid-epoch: emit the partial epoch too
+                    # (numbered as the epoch in progress) so the
+                    # nonfinite ledger reaches trainer.metrics — a
+                    # monitor reading metrics must see WHY the run
+                    # stopped early, not a clean truncation
+                    if acc_losses:
+                        tr._emit_epoch_end(
+                            units_done // self.per_epoch + 1,
+                            np.concatenate(acc_losses, axis=1),
+                            acc_dt, acc_samples)
+                    break
                 t_mark = time.time()
         finally:
             # exception-safe (a raising user callback must not leave
             # the feed pinning the host epoch tensors)
             if self.feed is not None:
                 self.feed.close()
+            if installed:
+                preemption.restore()
         tr.record_training_end()
         return all_losses

@@ -37,7 +37,6 @@ from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.chunking import run_chunked
-from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.trainers.windowed import AsynchronousDistributedTrainer
 from dist_keras_tpu.utils.pytree import tree_merge_floats, tree_zeros_like
 
@@ -156,8 +155,7 @@ class DynSGD(AsynchronousDistributedTrainer):
         total_t = self.num_epoch * spe
         W = self.communication_window
         mesh = self.mesh
-        step, opt_init = make_model_step(
-            model, loss_fn, tx, self.compute_dtype)
+        step, opt_init = self._make_step(model, loss_fn, tx)
         key = jax.random.PRNGKey(self.seed)
 
         def build_chunk(T, streamed=False):

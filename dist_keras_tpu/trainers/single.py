@@ -19,7 +19,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from dist_keras_tpu.trainers.base import Trainer
-from dist_keras_tpu.trainers.step import make_model_step
 
 
 class SingleTrainer(Trainer):
@@ -50,8 +49,7 @@ class SingleTrainer(Trainer):
         spb = xb.shape[0]  # steps per epoch
         total_t = self.num_epoch * spb
 
-        step, opt_init = make_model_step(
-            model, loss_fn, tx, self.compute_dtype)
+        step, opt_init = self._make_step(model, loss_fn, tx)
         params = model.params
         opt_state = opt_init(params)
         rng = jax.random.PRNGKey(self.seed)

@@ -9,6 +9,13 @@ the batch loop compiled (no per-batch Python, no recompiles, MXU stays hot).
 Mixed precision: ``compute_dtype=jnp.bfloat16`` casts parameters and inputs
 for the forward/backward while the master params and optimizer state stay
 float32 (loss is always reduced in f32).
+
+NaN guard (round 6): ``skip_nonfinite=True`` compiles a finite-check over
+(loss, grads) into the step and keeps the previous params/optimizer state
+when it fails — one exploding batch costs one skipped update instead of
+poisoning the run.  This is the device half of ``nan_policy="skip"``
+(``resilience.guards`` is the host half); it changes the traced program,
+so trainers key their jit cache on it.
 """
 
 from __future__ import annotations
@@ -37,11 +44,30 @@ def make_loss_fn(apply_fn, loss_fn, compute_dtype=None, training=True):
     return loss_of
 
 
-def make_sgd_step(apply_fn, loss_fn, tx, compute_dtype=None, training=True):
+def _all_finite(loss, grads):
+    """Scalar bool: loss and every float grad leaf are finite."""
+    ok = jnp.isfinite(loss)
+    for g in jax.tree.leaves(grads):
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def _select(ok, new, old):
+    """Pytree where(ok, new, old) — the skipped-update selector."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def make_sgd_step(apply_fn, loss_fn, tx, compute_dtype=None, training=True,
+                  skip_nonfinite=False):
     """-> step((params, opt_state, rng), (x, y)) -> (carry, loss).
 
     Shaped for ``lax.scan``: one local optimizer update per mini-batch,
-    the train_on_batch equivalent (workers.py:~115).
+    the train_on_batch equivalent (workers.py:~115).  With
+    ``skip_nonfinite`` a step whose loss or grads are NaN/Inf keeps the
+    incoming params AND optimizer state (the rng still advances, so the
+    schedule stays deterministic); the NaN loss is still emitted for the
+    host-side counter.
     """
     loss_of = make_loss_fn(apply_fn, loss_fn, compute_dtype, training)
     grad_fn = jax.value_and_grad(loss_of)
@@ -51,14 +77,19 @@ def make_sgd_step(apply_fn, loss_fn, tx, compute_dtype=None, training=True):
         x, y = batch
         rng, sub = jax.random.split(rng)
         loss, grads = grad_fn(params, x, y, sub)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state, rng), loss
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if skip_nonfinite:
+            ok = _all_finite(loss, grads)
+            new_params = _select(ok, new_params, params)
+            new_opt = _select(ok, new_opt, opt_state)
+        return (new_params, new_opt, rng), loss
 
     return step
 
 
-def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
+def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True,
+                    skip_nonfinite=False):
     """-> (step, opt_init) for a model object.
 
     For stateless models this is exactly ``make_sgd_step(model.apply, ...)``
@@ -83,7 +114,7 @@ def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
     has_state = getattr(model, "has_state", None)
     if has_state is None or not model.has_state():
         step = make_sgd_step(model.apply, loss_fn, tx, compute_dtype,
-                             training)
+                             training, skip_nonfinite=skip_nonfinite)
         return step, tx.init
 
     cast = getattr(model, "cast_params", None) or (
@@ -109,10 +140,17 @@ def make_model_step(model, loss_fn, tx, compute_dtype=None, training=True):
         rng, sub = jax.random.split(rng)
         trainable, state = model.split_state(params)
         (loss, new_state), grads = grad_fn(trainable, state, x, y, sub)
-        updates, opt_state = tx.update(grads, opt_state, trainable)
+        updates, new_opt = tx.update(grads, opt_state, trainable)
         trainable = optax.apply_updates(trainable, updates)
-        params = model.join_state(trainable, new_state)
-        return (params, opt_state, rng), loss
+        new_params = model.join_state(trainable, new_state)
+        if skip_nonfinite:
+            # a bad step keeps the previous params, running state
+            # (BatchNorm stats computed from the poisoned batch) AND
+            # optimizer state together
+            ok = _all_finite(loss, grads)
+            new_params = _select(ok, new_params, params)
+            new_opt = _select(ok, new_opt, opt_state)
+        return (new_params, new_opt, rng), loss
 
     def opt_init(params):
         return tx.init(model.split_state(params)[0])

@@ -42,7 +42,6 @@ from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.chunking import init_streaming, run_chunked
-from dist_keras_tpu.trainers.step import make_model_step
 from dist_keras_tpu.utils.pytree import (
     tree_add,
     tree_merge_floats,
@@ -164,8 +163,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
         mesh = self.mesh
         merge = self.merge
-        step, opt_init = make_model_step(
-            model, loss_fn, tx, self.compute_dtype)
+        step, opt_init = self._make_step(model, loss_fn, tx)
 
         def build_chunk(K, streamed=False):
             """K-window dispatch.  Resident mode: the whole (wpe, W, ...)

@@ -30,6 +30,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-size accuracy gates (TPU-run sizing — gates.py runs "
+        "them) and tests needing capabilities this image lacks "
+        "(multiprocess CPU collectives); excluded from the budgeted "
+        "tier-1 run via -m 'not slow'")
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--fast", action="store_true", default=False,

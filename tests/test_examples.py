@@ -202,6 +202,7 @@ def test_single_mnist_mlp(tmp_path, mnist_test, G):
 # ---------------------------------------------------------------------------
 # gate 2: ADAG — MNIST CNN, communication_window=12
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # full-size accuracy gate (TPU-run sizing; gates.py tier)
 def test_adag_mnist_cnn(mnist_train, mnist_test, G):
     t = ADAG(mnist_cnn(), num_workers=4, communication_window=12,
              worker_optimizer="adam",
@@ -217,6 +218,7 @@ def test_adag_mnist_cnn(mnist_train, mnist_test, G):
 # gate 3: DOWNPOUR SGD — MNIST CNN, lr warmup, 8 workers (as BASELINE
 # names it; see module doc for the window-2 stability analysis)
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # full-size accuracy gate (TPU-run sizing; gates.py tier)
 def test_downpour_mnist_cnn(mnist_train, mnist_test, G):
     # warmup spans the first ~4 epochs of local steps at either tier
     steps_per_epoch = G["mnist_n"] // (8 * 32)
@@ -237,6 +239,7 @@ def test_downpour_mnist_cnn(mnist_train, mnist_test, G):
 # ---------------------------------------------------------------------------
 # gate 4: AEASGD / EAMSGD — ATLAS-Higgs dense classifier
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # full-size accuracy gate (TPU-run sizing; gates.py tier)
 @pytest.mark.parametrize("cls,extra", [
     (AEASGD, {}),
     (EAMSGD, {"momentum": 0.9}),
@@ -271,6 +274,7 @@ def test_aeasgd_eamsgd_higgs(higgs_data, cls, extra, G):
 # chance). Measured margin: 8 workers, E=9 -> 0.60 vs 1-epoch control
 # 0.40.
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # full-size accuracy gate (TPU-run sizing; gates.py tier)
 def test_dynsgd_cifar10_parity(cifar_data, G):
     train, test = cifar_data
     n_workers = 8
@@ -355,6 +359,7 @@ print("OK", flush=True)
 """
 
 
+@pytest.mark.slow  # full-size accuracy gate (TPU-run sizing; gates.py tier)
 def test_dynsgd_cifar10_32workers(tmp_path, fast_gates):
     if fast_gates:
         pytest.skip("32-worker subprocess gate runs in the full tier only")

@@ -17,10 +17,9 @@ from dist_keras_tpu.parallel.moe import (
     switch_moe_ep,
 )
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+# jax_compat.shard_map: pre-vma jax needs check_rep=False on
+# composed-mesh programs (see dist_keras_tpu/utils/jax_compat.py)
+from dist_keras_tpu.utils.jax_compat import shard_map
 
 
 D, FF, E = 16, 32, 8

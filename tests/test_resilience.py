@@ -1,0 +1,713 @@
+"""Resilience subsystem: deterministic fault injection, retry/backoff,
+preemption-safe checkpointing, NaN guards (ISSUE 1 / round 6).
+
+The reference tolerated worker loss because Spark re-ran failed
+partitions; here the failure story is built in and PROVEN: every test in
+this file kills, corrupts or starves a real seam (checkpoint commit,
+rsync transport, stream fetch, loss stream) at an exact call count and
+asserts the framework recovers to bit-exact state — no timing, no
+flakes."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.resilience import (
+    FaultInjected,
+    NonFiniteLossError,
+    Preempted,
+    RetryPolicy,
+    faults,
+    preemption,
+)
+from dist_keras_tpu.resilience.retry import retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    preemption.clear()
+    yield
+    faults.clear()
+    preemption.clear()
+    preemption.restore()
+
+
+# ---------------------------------------------------------------------------
+# faults: the injection harness itself
+# ---------------------------------------------------------------------------
+def test_fault_point_unarmed_passthrough():
+    before = faults.call_count("x.unarmed")
+    assert faults.fault_point("x.unarmed", value=41) == 41
+    assert faults.fault_point("x.unarmed") is None
+    assert faults.call_count("x.unarmed") == before + 2
+
+
+def test_fault_schedule_is_relative_and_exact():
+    # consume two calls BEFORE arming: at= counts from the arming moment
+    faults.fault_point("x.sched")
+    faults.fault_point("x.sched")
+    faults.inject("x.sched", at=1, times=2)
+    faults.fault_point("x.sched")  # at=0 relative: clean
+    with pytest.raises(FaultInjected):
+        faults.fault_point("x.sched")  # at=1
+    with pytest.raises(FaultInjected):
+        faults.fault_point("x.sched")  # at=2 (times=2)
+    faults.fault_point("x.sched")  # schedule exhausted: clean again
+
+
+def test_fault_actions_corrupt_and_replace():
+    faults.inject("x.corrupt", action="corrupt")
+    arr = faults.fault_point("x.corrupt", value=np.ones(4, np.float32))
+    assert np.isnan(arr[0]) and np.isfinite(arr[1:]).all()
+    faults.inject("x.replace", action="replace", value=30)
+    assert faults.fault_point("x.replace", value=0) == 30
+
+
+def test_fault_armed_context_disarms():
+    with faults.armed("x.ctx"):
+        with pytest.raises(FaultInjected):
+            faults.fault_point("x.ctx")
+    faults.fault_point("x.ctx")  # disarmed after the block
+
+
+def test_fault_env_schedule(monkeypatch):
+    monkeypatch.setenv(
+        "DK_FAULTS", "x.env@1;y.env@0x2:action=replace,value=7")
+    faults.load_env(force=True)
+    faults.fault_point("x.env")
+    with pytest.raises(FaultInjected):
+        faults.fault_point("x.env")
+    assert faults.fault_point("y.env", value=0) == 7
+    assert faults.fault_point("y.env", value=0) == 7
+    assert faults.fault_point("y.env", value=0) == 0
+
+
+def test_fault_env_malformed_entry_fails_loudly(monkeypatch):
+    monkeypatch.setenv("DK_FAULTS", ":action=raise")
+    with pytest.raises(ValueError, match="malformed DK_FAULTS"):
+        faults.load_env(force=True)
+
+
+def test_fault_custom_exception_type():
+    faults.inject("x.exc", exc=OSError)
+    with pytest.raises(OSError):
+        faults.fault_point("x.exc")
+
+
+# ---------------------------------------------------------------------------
+# retry: schedule, give-up, deadline
+# ---------------------------------------------------------------------------
+def test_retry_backoff_schedule_and_recovery():
+    sleeps, calls = [], []
+    pol = RetryPolicy(attempts=4, backoff=0.1, multiplier=2.0, jitter=0.0,
+                      retryable=(OSError,), sleep=sleeps.append)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]  # exponential, deterministic (jitter=0)
+
+
+def test_retry_gives_up_with_original_error():
+    sleeps = []
+    pol = RetryPolicy(attempts=3, backoff=0.01, jitter=0.0,
+                      retryable=(OSError,), sleep=sleeps.append)
+
+    def dead():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent") as ei:
+        pol.call(dead)
+    assert ei.value._retry_attempts == 3
+    assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+def test_retry_nonretryable_passes_straight_through():
+    calls = []
+    pol = RetryPolicy(attempts=5, retryable=(OSError,),
+                      sleep=lambda s: None)
+
+    def typed():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        pol.call(typed)
+    assert len(calls) == 1
+
+
+def test_retry_deadline_stops_early():
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    pol = RetryPolicy(attempts=100, backoff=1.0, multiplier=1.0,
+                      jitter=0.0, timeout=2.5, retryable=(OSError,),
+                      sleep=fake_sleep, clock=lambda: clock["t"])
+
+    def dead():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        pol.call(dead)
+    # 1.0 + 1.0 spent sleeping, third sleep clipped to the 0.5 left,
+    # then the deadline blocks any further attempt
+    assert sum(sleeps) <= 2.5 + 1e-9
+    assert len(sleeps) <= 3
+
+
+def test_retry_jitter_is_deterministic():
+    a = RetryPolicy(attempts=2, backoff=1.0, jitter=0.5, seed=7)
+    b = RetryPolicy(attempts=2, backoff=1.0, jitter=0.5, seed=7)
+    da, db = a.delay(1), b.delay(1)
+    assert da == db and 0.5 <= da <= 1.5
+
+
+def test_retry_decorator():
+    calls = []
+
+    @retry(attempts=3, backoff=0.0, retryable=(OSError,),
+           sleep=lambda s: None)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError
+        return 5
+
+    assert flaky() == 5
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpointer: atomic commit, tmp GC, retried writes
+# ---------------------------------------------------------------------------
+def _ckptr(tmp_path, **kw):
+    from dist_keras_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "ck"), **kw)
+    ck._retry.sleep = lambda s: None  # tests never wall-sleep
+    return ck
+
+
+def test_checkpoint_kill_mid_write_leaves_previous_step_restorable(
+        tmp_path):
+    """The acceptance scenario: a save killed between write and commit
+    leaves only a tmp orphan; a fresh Checkpointer GCs it and restores
+    the previous committed step bit-exactly."""
+    ck = _ckptr(tmp_path)
+    state1 = {"a": np.arange(4.0), "b": np.int32(3)}
+    ck.save(1, state1)
+    with faults.armed("checkpoint.save"):
+        with pytest.raises(FaultInjected):
+            ck.save(2, {"a": np.arange(4.0) * 2, "b": np.int32(9)})
+    names = sorted(os.listdir(ck.directory))
+    assert any(n.startswith("step_00000002") for n in names)  # orphan tmp
+    assert "step_00000002" not in names                       # no commit
+
+    ck2 = _ckptr(tmp_path)  # "restarted process"
+    assert ck2.all_steps() == [1]  # the orphan is ignored, not a step
+    step, restored = ck2.restore(template=state1)
+    assert step == 1
+    np.testing.assert_array_equal(restored["a"], state1["a"])
+    np.testing.assert_array_equal(restored["b"], state1["b"])
+    # the orphan tmp is garbage-collected by the writer's NEXT
+    # successful commit (never by a read-only query — see the
+    # concurrent-reader test below)
+    ck2.save(3, state1)
+    assert not any("tmp" in n for n in os.listdir(ck2.directory))
+    assert ck2.all_steps() == [1, 3]
+
+
+def test_checkpoint_save_retries_transient_oserror(tmp_path):
+    ck = _ckptr(tmp_path)
+    faults.inject("checkpoint.save", at=0, times=2, exc=OSError)
+    ck.save(5, {"a": np.ones(3)})  # two failures absorbed, third commits
+    assert ck.all_steps() == [5]
+    _, restored = ck.restore(template={"a": np.ones(3)})
+    np.testing.assert_array_equal(restored["a"], np.ones(3))
+
+
+def test_checkpoint_save_gives_up_after_budget(tmp_path):
+    ck = _ckptr(tmp_path)
+    faults.inject("checkpoint.save", at=0, times=99, exc=OSError)
+    with pytest.raises(OSError):
+        ck.save(5, {"a": np.ones(3)})
+    ck2 = _ckptr(tmp_path)
+    assert ck2.all_steps() == []  # nothing half-committed
+
+
+def test_checkpoint_overwrite_same_step_is_atomic(tmp_path):
+    ck = _ckptr(tmp_path)
+    ck.save(3, {"a": np.zeros(2)})
+    ck.save(3, {"a": np.full(2, 7.0)})  # force-overwrite via rename
+    assert ck.all_steps() == [3]
+    _, restored = ck.restore(template={"a": np.zeros(2)})
+    np.testing.assert_array_equal(restored["a"], np.full(2, 7.0))
+
+
+def test_checkpoint_overwrite_kill_mid_swap_keeps_old_version(tmp_path):
+    """A kill between retiring step_N to step_N.old and committing the
+    new step_N must not lose the committed version: all_steps() rolls
+    the .old back."""
+    ck = _ckptr(tmp_path)
+    ck.save(3, {"a": np.zeros(2)})
+    with faults.armed("checkpoint.commit"):
+        with pytest.raises(FaultInjected):
+            ck.save(3, {"a": np.full(2, 7.0)})
+    names = sorted(os.listdir(ck.directory))
+    assert "step_00000003" not in names        # mid-swap state on disk
+    assert "step_00000003.old" in names
+
+    ck2 = _ckptr(tmp_path)  # restart
+    assert ck2.all_steps() == [3]              # rolled back
+    _, restored = ck2.restore(template={"a": np.zeros(2)})
+    np.testing.assert_array_equal(restored["a"], np.zeros(2))  # OLD data
+
+
+def test_checkpoint_reader_never_deletes_writer_staging(tmp_path):
+    """A read-only poller (second Checkpointer on the same directory)
+    must not GC another process's in-progress tmp dir."""
+    ck = _ckptr(tmp_path)
+    ck.save(1, {"a": np.zeros(2)})
+    staging = os.path.join(ck.directory, "step_00000002.tmp")
+    os.makedirs(staging)  # a concurrent writer mid-save
+    reader = _ckptr(tmp_path)
+    assert reader.all_steps() == [1]
+    assert reader.latest_step() == 1
+    assert os.path.isdir(staging)  # untouched by the read-only queries
+
+
+def test_checkpoint_retention_still_prunes(tmp_path):
+    ck = _ckptr(tmp_path, max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        ck.save(s, {"a": np.float32(s)})
+    assert ck.all_steps() == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# trainer-level: fault-injected save kill -> resume bit-exact parity
+# ---------------------------------------------------------------------------
+def _digits_subset():
+    from sklearn.datasets import load_digits
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    digits = load_digits()
+    x = (digits.data / 16.0).astype(np.float32)[:256]
+    y = digits.target[:256]
+    return Dataset({"features": x, "label": y,
+                    "label_encoded": one_hot(y, 10)})
+
+
+def _model():
+    from dist_keras_tpu.models import Dense, Sequential
+
+    m = Sequential([Dense(16, activation="relu"), Dense(10)])
+    m.build((64,), seed=0)
+    return m
+
+
+_KW = dict(loss="categorical_crossentropy", worker_optimizer="adam",
+           batch_size=16, label_col="label_encoded", seed=3)
+
+
+def test_killed_checkpoint_save_then_resume_bit_exact(tmp_path):
+    """Acceptance criterion: kill a Checkpointer.save mid-write during
+    training; the run dies, the directory is restorable to the previous
+    committed step, and the resumed run's final weights are BIT-EQUAL to
+    an uninterrupted run's."""
+    import dist_keras_tpu as dk
+
+    ds = _digits_subset()
+    ckdir = str(tmp_path / "ck")
+    # saves land at epochs 2 and 4 (step-granular); kill the SECOND save
+    t1 = dk.SingleTrainer(_model(), num_epoch=4, checkpoint_dir=ckdir,
+                          checkpoint_every=2, max_checkpoints=10, **_KW)
+    faults.inject("checkpoint.save", at=1)
+    with pytest.raises(FaultInjected):
+        t1.train(ds)
+    faults.clear()
+
+    spb = len(ds) // 16
+    t2 = dk.SingleTrainer(_model(), num_epoch=4, checkpoint_dir=ckdir,
+                          checkpoint_every=2, max_checkpoints=10,
+                          resume=True, **_KW)
+    assert t2._checkpointer_or_none().all_steps() == [2 * spb]  # epoch 2
+    resumed = t2.train(ds)
+
+    control = dk.SingleTrainer(_model(), num_epoch=4, **_KW).train(ds)
+    for wa, wb in zip(resumed.get_weights(), control.get_weights()):
+        np.testing.assert_array_equal(wa, wb)  # bit-equal
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> boundary checkpoint -> exit code -> resume
+# ---------------------------------------------------------------------------
+def test_sigterm_checkpoints_and_resumes_bit_exact(tmp_path):
+    """A real SIGTERM delivered mid-run: the trainer saves at the next
+    chunk boundary, raises Preempted (SystemExit code 143), and a
+    resume=True rerun matches the uninterrupted run bit-exactly."""
+    import dist_keras_tpu as dk
+
+    ds = _digits_subset()
+    ckdir = str(tmp_path / "ck")
+
+    def kill_after_epoch_2(trainer, epoch, logs):
+        if epoch == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    t1 = dk.SingleTrainer(_model(), num_epoch=6, checkpoint_dir=ckdir,
+                          checkpoint_every=2, handle_preemption=True,
+                          callbacks=[kill_after_epoch_2], **_KW)
+    with pytest.raises(Preempted) as ei:
+        t1.train(ds)
+    assert ei.value.code == 128 + signal.SIGTERM  # 143
+    assert ei.value.saved_step is not None
+    # the graceful window is torn down after the run
+    assert signal.getsignal(signal.SIGTERM) != preemption._handler
+
+    t2 = dk.SingleTrainer(_model(), num_epoch=6, checkpoint_dir=ckdir,
+                          checkpoint_every=2, resume=True, **_KW)
+    resumed = t2.train(ds)
+    control = dk.SingleTrainer(_model(), num_epoch=6, **_KW).train(ds)
+    for wa, wb in zip(resumed.get_weights(), control.get_weights()):
+        np.testing.assert_array_equal(wa, wb)
+
+
+def test_preemption_without_checkpointer_still_exits_conventionally():
+    import dist_keras_tpu as dk
+
+    ds = _digits_subset()
+
+    def kill(trainer, epoch, logs):
+        preemption.request(signal.SIGINT)
+
+    t = dk.SingleTrainer(_model(), num_epoch=3, handle_preemption=True,
+                         callbacks=[kill], **_KW)
+    with pytest.raises(Preempted) as ei:
+        t.train(ds)
+    assert ei.value.code == 128 + signal.SIGINT  # 130
+    assert ei.value.saved_step is None  # nothing to save to
+
+
+def test_preempt_drain_with_nan_halt_does_not_checkpoint(tmp_path):
+    """If the pre-preemption drain itself trips the NaN sentinel under
+    nan_policy='halt', the boundary checkpoint must be SKIPPED — the
+    scheduler would otherwise restart-and-resume from diverged state."""
+    from dist_keras_tpu.checkpoint import Checkpointer
+    from dist_keras_tpu.trainers.chunking import ChunkRunner
+
+    class FakeTrainer:
+        handle_preemption = True
+        nan_policy = "halt"
+        nonfinite_steps = 0
+        callbacks = []
+
+        def __init__(self, d):
+            self._ck = Checkpointer(d)
+
+        def _checkpointer_or_none(self):
+            return self._ck
+
+        def record_training_start(self):
+            pass
+
+        def record_training_end(self):
+            pass
+
+        def _emit_epoch_end(self, *a):
+            pass
+
+    tr = FakeTrainer(str(tmp_path / "ck"))
+    runner = ChunkRunner(tr, plan=[2, 2], start=0, total=4, per_epoch=4,
+                         samples_per_unit=1, cadence=None)
+
+    def dispatch(i, K, units_done, data):
+        if i == 0:  # signal lands while chunk 0 is in flight
+            preemption.request(signal.SIGTERM)
+        return np.full((1, K), np.nan if i == 0 else 0.0, np.float32)
+
+    with pytest.raises(Preempted) as ei:
+        runner.run(dispatch, sync_ref=lambda: (),
+                   state_fn=lambda: {"x": np.float32(1)})
+    assert ei.value.saved_step is None  # halted: nothing persisted
+    assert tr._ck.all_steps() == []
+    assert tr.nonfinite_steps > 0
+
+
+def test_preempted_is_systemexit():
+    e = Preempted(signal.SIGTERM)
+    assert isinstance(e, SystemExit)
+    assert e.exit_code == 143
+
+
+def test_second_signal_escalates_to_previous_handler():
+    """First delivery = graceful flag only (an exiting displaced handler
+    must not kill the process before the boundary checkpoint); second
+    delivery = hand off to the displaced handler."""
+    calls = []
+    prev = lambda s, f: calls.append(s)  # noqa: E731 - bench-style
+    old = signal.signal(signal.SIGTERM, prev)
+    try:
+        assert preemption.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preemption.requested() == signal.SIGTERM
+        assert calls == []  # graceful: displaced handler NOT run
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert calls == [signal.SIGTERM]  # escalation path
+    finally:
+        preemption.restore()
+        signal.signal(signal.SIGTERM, old)
+
+
+# ---------------------------------------------------------------------------
+# NaN policy matrix
+# ---------------------------------------------------------------------------
+def _poisoned_blobs(n=256, d=8):
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, size=n)
+    x = (np.stack([np.full(d, -1.0), np.full(d, 1.0)])[y]
+         + rng.normal(size=(n, d))).astype(np.float32)
+    x[5] = np.nan  # one poisoned row -> NaN loss on its batch
+    return Dataset({"features": x, "label": y,
+                    "label_encoded": one_hot(y, 2)})
+
+
+def _small_model(d=8):
+    from dist_keras_tpu.models import Dense, Sequential
+
+    m = Sequential([Dense(8, activation="relu"), Dense(2)])
+    m.build((d,), seed=0)
+    return m
+
+
+_NAN_KW = dict(loss="categorical_crossentropy", batch_size=16,
+               num_epoch=2, label_col="label_encoded", seed=3)
+
+NAN_TRAINERS = [
+    ("SingleTrainer", {}),
+    ("ADAG", {"num_workers": 4, "communication_window": 2}),
+    ("AveragingTrainer", {"num_workers": 4}),
+    ("DynSGD", {"num_workers": 4, "communication_window": 2}),
+]
+
+
+@pytest.mark.parametrize("name,extra", NAN_TRAINERS)
+def test_nan_policy_raise_aborts(name, extra):
+    import dist_keras_tpu as dk
+
+    t = getattr(dk, name)(_small_model(), nan_policy="raise",
+                          **extra, **_NAN_KW)
+    with pytest.raises(NonFiniteLossError):
+        t.train(_poisoned_blobs())
+    assert t.nonfinite_steps > 0
+
+
+@pytest.mark.parametrize("name,extra", NAN_TRAINERS)
+def test_nan_policy_skip_keeps_weights_finite(name, extra):
+    import dist_keras_tpu as dk
+
+    t = getattr(dk, name)(_small_model(), nan_policy="skip",
+                          **extra, **_NAN_KW)
+    out = t.train(_poisoned_blobs())
+    model = out[0] if isinstance(out, list) else out
+    assert all(np.isfinite(w).all() for w in model.get_weights())
+    assert t.nonfinite_steps > 0
+    assert sum(m["nonfinite_steps"] for m in t.metrics) \
+        == t.nonfinite_steps
+
+
+def test_nan_policy_skip_matches_clean_run_when_no_nans():
+    """The compiled finite-guard must be a no-op on healthy data."""
+    import dist_keras_tpu as dk
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, 128)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    ds = Dataset({"features": x, "label": y,
+                  "label_encoded": one_hot(y, 2)})
+    a = dk.SingleTrainer(_small_model(), nan_policy="skip",
+                         **_NAN_KW).train(ds)
+    b = dk.SingleTrainer(_small_model(), nan_policy=None,
+                         **_NAN_KW).train(ds)
+    for wa, wb in zip(a.get_weights(), b.get_weights()):
+        np.testing.assert_array_equal(wa, wb)
+
+
+def test_nan_policy_halt_stops_without_checkpointing(tmp_path):
+    import dist_keras_tpu as dk
+
+    ckdir = str(tmp_path / "ck")
+    t = dk.SingleTrainer(_small_model(), nan_policy="halt",
+                         checkpoint_dir=ckdir, checkpoint_every=1,
+                         **_NAN_KW)
+    t.train(_poisoned_blobs())
+    assert t.nonfinite_steps > 0
+    # the poisoned boundary's save was SKIPPED: no post-divergence state
+    assert t._checkpointer_or_none().all_steps() == []
+
+
+def test_nan_policy_off_counts_only():
+    import dist_keras_tpu as dk
+
+    t = dk.SingleTrainer(_small_model(), nan_policy=None, **_NAN_KW)
+    t.train(_poisoned_blobs())  # completes despite the NaNs
+    assert t.nonfinite_steps > 0
+
+
+def test_nan_injection_via_step_loss_fault():
+    """The host-side sentinel alone, exercised by corrupting the fetched
+    loss array (device math untouched)."""
+    import dist_keras_tpu as dk
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 2, 128)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    ds = Dataset({"features": x, "label": y,
+                  "label_encoded": one_hot(y, 2)})
+    faults.inject("step.loss", action="corrupt")
+    t = dk.SingleTrainer(_small_model(), nan_policy="raise", **_NAN_KW)
+    with pytest.raises(NonFiniteLossError):
+        t.train(ds)
+
+
+def test_unknown_nan_policy_rejected():
+    import dist_keras_tpu as dk
+
+    with pytest.raises(ValueError):
+        dk.SingleTrainer(_small_model(), nan_policy="explode", **_NAN_KW)
+
+
+# ---------------------------------------------------------------------------
+# launch: retried transport + manifest reads
+# ---------------------------------------------------------------------------
+def _job(tmp_path, **kw):
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jobdir"
+    jd.mkdir(exist_ok=True)
+    job = Job("secret", "j1", str(jd), hosts=["h1", "h2"], dry_run=True,
+              **kw)
+    job.retry_policy.sleep = lambda s: None
+    return job
+
+
+def test_job_sync_recovers_from_twice_failing_rsync(tmp_path):
+    """Acceptance criterion: a twice-failing Job.sync recovers without
+    operator intervention."""
+    job = _job(tmp_path)
+    faults.inject("job.rsync", at=0, times=2, action="replace", value=30)
+    assert job.sync() == 0
+    # host h1's command retried twice then passed; h2 clean: 4 total
+    assert len(job.commands) == 4
+
+
+def test_job_sync_gives_up_after_budget(tmp_path):
+    job = _job(tmp_path, retries=2)
+    faults.inject("job.rsync", at=0, times=99, action="replace", value=30)
+    assert job.sync() == 30
+    # every host burned its full budget (3 attempts each)
+    assert len(job.commands) == 6
+
+
+def test_job_launch_not_retried_by_default(tmp_path):
+    """The launch ssh's remote nohup is not idempotent — a retry after a
+    post-fork connection drop would double-start the trainer — so the
+    default budget is zero: the failure surfaces as nonzero rc for the
+    job-granular re-send (Punchcard's next poll)."""
+    job = _job(tmp_path)
+    faults.inject("job.ssh", at=0, times=1, action="replace", value=255)
+    assert job.launch() == 255
+    assert len(job.commands) == 2  # one attempt per host, no retries
+
+
+def test_job_launch_retries_only_when_opted_in(tmp_path):
+    job = _job(tmp_path, launch_retries=1)
+    job.launch_retry_policy.sleep = lambda s: None
+    faults.inject("job.ssh", at=0, times=1, action="replace", value=255)
+    assert job.launch() == 0
+    assert len(job.commands) == 3
+
+
+def test_punchcard_manifest_read_retries_torn_write(tmp_path):
+    from dist_keras_tpu.launch.job import Punchcard
+
+    manifest = tmp_path / "m.json"
+    jd = tmp_path / "jd"
+    jd.mkdir()
+    manifest.write_text(json.dumps([{
+        "secret": "s", "job_name": "a", "job_dir": str(jd),
+        "hosts": ["h1"]}]))
+    pc = Punchcard(str(manifest), secrets=("s",), dry_run=True)
+    pc.read_policy.sleep = lambda s: None
+    faults.inject("punchcard.read_manifest", at=0, times=2, exc=OSError)
+    jobs = pc.run_once()
+    assert len(jobs) == 1 and jobs[0].last_rc == 0
+
+
+def test_job_config_accepts_retry_fields(tmp_path):
+    from dist_keras_tpu.launch.config import JobConfig
+
+    jd = tmp_path / "jd"
+    jd.mkdir()
+    cfg = JobConfig.from_dict({
+        "job_name": "a", "job_dir": str(jd), "hosts": ["h1"],
+        "retries": 5, "retry_backoff": 0.1})
+    job = cfg.to_job(dry_run=True)
+    assert job.retry_policy.attempts == 6
+
+
+# ---------------------------------------------------------------------------
+# streaming: retried fetch
+# ---------------------------------------------------------------------------
+def test_streaming_predictor_retries_transient_fetch():
+    from dist_keras_tpu.data.streaming import (
+        QueueSource,
+        StreamingPredictor,
+    )
+
+    src = QueueSource()
+    for i in range(8):
+        src.put(np.full(8, float(i), np.float32))
+    src.close()
+    pred = StreamingPredictor(_small_model(), batch_size=4)
+    pred.fetch_retry.sleep = lambda s: None
+    faults.inject("stream.fetch", at=1, times=2, exc=OSError)
+    total = pred.run(src, lambda rows, preds: None)
+    assert total == 8  # both transient fetch failures absorbed
+
+
+def test_streaming_predictor_fatal_fetch_propagates():
+    from dist_keras_tpu.data.streaming import (
+        QueueSource,
+        StreamingPredictor,
+    )
+
+    src = QueueSource()
+    src.put(np.zeros(8, np.float32))
+    pred = StreamingPredictor(_small_model(), batch_size=4)
+    faults.inject("stream.fetch", at=0, times=1)  # FaultInjected: fatal
+    with pytest.raises(FaultInjected):
+        pred.run(src, lambda rows, preds: None)
