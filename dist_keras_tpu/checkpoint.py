@@ -22,6 +22,21 @@ the writer garbage-collects orphaned tmp/staging dirs and superseded
 (``resilience.retry``); the mid-write and mid-swap instants are named
 fault points (``"checkpoint.save"`` / ``"checkpoint.commit"``) so every
 kill scenario is deterministically testable.
+
+This PR — two-phase commit for MULTI-HOST saves (world > 1, resolved
+from ``resilience.coordination``): phase 1, every host writes its
+payload to ``step_N.mh/host_{i}.tmp``, fsyncs, atomically renames it to
+``step_N.mh/host_{i}`` and publishes a ``host-{i}.ok`` marker; phase 2,
+the LEADER (rank 0) waits — under a deadline, a missing marker raises a
+typed ``PeerLost`` naming the rank, never a hang — for all markers, then
+promotes the whole staging directory with the same journaled
+rename-swap, which is the single commit instant for the cluster.
+``latest_step``/``restore`` only ever see promoted directories, so a
+save killed between one host's rename and full commit (the
+``"coord.commit"`` fault point fires exactly there) is invisible: resume
+falls back to the last FULLY committed step on every host.  Orphan GC
+and retention are leader-only in multi-host mode — two hosts must not
+race a third host's in-flight rename.
 """
 
 from __future__ import annotations
@@ -42,6 +57,18 @@ except Exception:  # pragma: no cover - orbax is in the image
     _HAVE_ORBAX = False
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _two_phase_enabled():
+    """The multi-host two-phase commit assumes ``checkpoint_dir`` is
+    SHARED storage (NFS/GCS) — that is where cross-host markers can
+    rendezvous.  A pod whose checkpoint_dir is per-host LOCAL scratch
+    must opt out with ``DK_CKPT_TWO_PHASE=0``: each host then keeps the
+    round-6 independent atomic save (the leader's marker wait would
+    otherwise stall against markers that land on other machines'
+    disks)."""
+    return os.environ.get("DK_CKPT_TWO_PHASE", "1").lower() \
+        not in ("0", "off", "no", "false")
 
 
 def _fsync_dir(path):
@@ -109,11 +136,21 @@ class Checkpointer:
     directory; falls back to pickled-npz when orbax is unavailable.
     """
 
-    def __init__(self, directory, max_to_keep=3, fsync=True, retry=None):
+    def __init__(self, directory, max_to_keep=3, fsync=True, retry=None,
+                 rank=None, world=None, commit_timeout_s=None,
+                 commit_poll_s=0.02):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = int(max_to_keep)
         self.fsync = bool(fsync)
+        # multi-host identity: None = resolve lazily per save/restore
+        # from resilience.coordination (DK_COORD_* env, else the jax
+        # process group).  world > 1 switches save() to the two-phase
+        # commit and restore() to the per-host payload layout.
+        self._rank = rank
+        self._world = world
+        self.commit_timeout_s = commit_timeout_s  # None -> coord default
+        self.commit_poll_s = float(commit_poll_s)
         # transient FS errors (NFS hiccup, disk-full races with retention)
         # are retried; FaultInjected is deliberately NOT retryable, so an
         # injected mid-write kill stays a kill (guards the test contract)
@@ -128,6 +165,19 @@ class Checkpointer:
 
     def _step_dir(self, step):
         return os.path.join(self.directory, f"step_{step:08d}")
+
+    def _coord_ids(self):
+        """(rank, world) — explicit constructor values win; otherwise
+        resolved from resilience.coordination at call time (so one
+        Checkpointer class serves laptop and pod unchanged)."""
+        if self._rank is not None and self._world is not None:
+            return int(self._rank), int(self._world)
+        from dist_keras_tpu.resilience import coordination
+
+        rank = coordination.rank() if self._rank is None else self._rank
+        world = (coordination.world() if self._world is None
+                 else self._world)
+        return int(rank), int(world)
 
     def all_steps(self):
         """Committed steps — STRICTLY read-only, so any number of
@@ -155,13 +205,60 @@ class Checkpointer:
             return final + ".old"
         return final
 
+    def _payload_dir(self, path):
+        """The payload inside a committed step: the step dir itself for
+        single-host saves, ``host_{rank}`` for a promoted two-phase
+        save.  A rank BEYOND the writing world (resume with a larger
+        world) reads the leader's replica; a rank WITHIN it whose
+        payload is missing is a corrupt step and must be an error —
+        silently restoring another host's state (per-host optimizer
+        slots, staleness counters) would diverge the run."""
+        rank, _world = self._coord_ids()
+        try:
+            names = os.listdir(path)
+        except OSError:
+            names = []
+        hosts = sorted(n for n in names if n.startswith("host_")
+                       and os.path.isdir(os.path.join(path, n)))
+        if not hosts:
+            return path  # single-host layout
+        mine = f"host_{rank}"
+        if mine in hosts:
+            return os.path.join(path, mine)
+        # the writing world is recorded by the promoted host-ok markers
+        # (a deleted payload dir must not shrink it and turn a corrupt
+        # step into a silent leader-replica fallback)
+        wrote = max(len(hosts),
+                    sum(1 for n in names
+                        if re.fullmatch(r"host-\d+\.ok", n)))
+        if rank >= wrote:
+            return os.path.join(path, "host_0")
+        raise RuntimeError(
+            f"checkpoint {path} was written by {wrote} hosts but is "
+            f"missing this rank's payload {mine!r} (present: {hosts}) "
+            "— a promoted step should contain every writer's payload; "
+            "refusing to silently restore another host's state")
+
     def _gc_orphans(self):
         """Writer-side sweep (after a successful commit): remove staging
         dirs no save will ever commit — interrupted ``step_N.tmp``,
-        orbax staging leftovers, and ``.old`` copies whose final exists.
-        Never runs from read-only queries."""
+        torn ``step_N.mh`` stagings, orbax staging leftovers, and
+        ``.old`` copies whose final exists.  Never runs from read-only
+        queries, and in multi-host mode it is LEADER-ONLY: a non-leader
+        sweeping here could race another host's in-flight
+        ``host_{i}.tmp`` -> ``host_{i}`` rename inside a shared staging
+        directory (the round-6 single-writer assumption does not hold on
+        a pod)."""
         import shutil
 
+        rank, world = self._coord_ids()
+        if world > 1 and rank != 0 and _two_phase_enabled():
+            # (with two-phase opted out the directory is per-host local
+            # scratch: this host is its sole writer and must keep
+            # sweeping it itself)
+            return
+        inflight_step = (int(self._inflight.split("_")[1])
+                         if self._inflight else None)
         for name in os.listdir(self.directory):
             full = os.path.join(self.directory, name)
             if not name.startswith("step_") or _STEP_RE.match(name):
@@ -172,6 +269,18 @@ class Checkpointer:
                 if os.path.exists(full[:-4]):  # superseded retired copy
                     shutil.rmtree(full, ignore_errors=True)
                 continue  # sole copy of its step: keep (read path)
+            if world > 1 and name.endswith(".mh") \
+                    and _STEP_RE.match(name[:-3]):
+                # a staging dir for a NEWER step than the one this
+                # leader just committed may be a fast peer's IN-FLIGHT
+                # phase 1 (saves outside the lockstepped boundary loop
+                # are not synchronized) — deleting it would destroy
+                # that host's payload and strand the next promotion.
+                # Steps are saved in increasing order, so only staging
+                # provably superseded by the current save is swept.
+                if inflight_step is None \
+                        or int(name[:-3].split("_")[1]) >= inflight_step:
+                    continue
             shutil.rmtree(full, ignore_errors=True)
 
     def latest_step(self):
@@ -185,8 +294,18 @@ class Checkpointer:
         committed steps or old + new — ``restore`` can never observe a
         partial write.  The window between write and commit is the
         ``"checkpoint.save"`` fault point.
+
+        Multi-host (world > 1): the two-phase protocol instead — every
+        host stages its payload + ``host-{i}.ok`` marker under
+        ``step_N.mh``, the leader promotes the staging directory to the
+        committed ``step_N`` only when ALL markers have landed (deadline
+        -> typed ``PeerLost``, never a hang).
         """
         state = _to_host(state)
+        rank, world = self._coord_ids()
+        if world > 1 and _two_phase_enabled():
+            self._save_multihost(step, state, rank, world)
+            return
         final = self._step_dir(step)
         tmp = final + ".tmp"
         self._inflight = os.path.basename(final)
@@ -197,13 +316,13 @@ class Checkpointer:
             self._inflight = None
         self._retain()
 
-    def _save_once(self, tmp, final, state):
-        from dist_keras_tpu.resilience.faults import fault_point
-
+    def _write_payload(self, tmp, state):
+        """Write ``state`` into the staging dir ``tmp`` (clean-slate) and
+        fsync it — the write half of every commit protocol here."""
         import shutil
 
         # a retry (or an earlier interrupted save of the same step)
-        # may have left either path behind — start clean
+        # may have left the path behind — start clean
         shutil.rmtree(tmp, ignore_errors=True)
         if self._ckpt is not None:
             self._ckpt.save(tmp, state, force=True)
@@ -219,22 +338,148 @@ class Checkpointer:
                 pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
         if self.fsync:
             _fsync_tree(tmp)
-        # the deterministic mid-write kill: tmp written, not yet committed
-        fault_point("checkpoint.save")
-        # journaled overwrite swap: the committed version is RETIRED to
-        # step_N.old (not deleted) before the new one lands, so a kill
-        # between the two renames loses nothing — all_steps() rolls the
-        # .old back when it finds no committed final
+
+    def _swap_in(self, src, final):
+        """Journaled overwrite swap: the committed version is RETIRED to
+        step_N.old (not deleted) before the new one lands, so a kill
+        between the two renames loses nothing — all_steps() rolls the
+        .old back when it finds no committed final.  The instant between
+        retire and commit is the ``"checkpoint.commit"`` fault point."""
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        import shutil
+
         trash = final + ".old"
         if os.path.exists(final):
             shutil.rmtree(trash, ignore_errors=True)  # stale leftover
             os.rename(final, trash)
         # the deterministic mid-swap kill (old retired, new not committed)
         fault_point("checkpoint.commit")
-        os.rename(tmp, final)
+        os.rename(src, final)
         shutil.rmtree(trash, ignore_errors=True)  # new committed: old goes
         if self.fsync:
             _fsync_dir(self.directory)  # persist the renames themselves
+
+    def _save_once(self, tmp, final, state):
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        self._write_payload(tmp, state)
+        # the deterministic mid-write kill: tmp written, not yet committed
+        fault_point("checkpoint.save")
+        self._swap_in(tmp, final)
+
+    # -- multi-host two-phase commit ------------------------------------
+    def _staging_dir(self, step):
+        # deliberately NOT matching _STEP_RE: an unpromoted staging dir
+        # is invisible to all_steps/latest_step/restore by construction
+        return self._step_dir(step) + ".mh"
+
+    def _marker(self, stage, rank):
+        return os.path.join(stage, f"host-{rank}.ok")
+
+    def _save_host_once(self, stage, rank, state):
+        """Phase 1 on one host: retract own marker -> payload -> fsync
+        -> atomic rename -> durable -> publish the ``host-{i}.ok``
+        marker LAST.  The retraction runs on EVERY attempt (this
+        function is the retry unit): a marker left published from a
+        previous attempt would let the leader promote while this host
+        is still rewriting its payload.  Marker-after-durable means a
+        visible marker always implies a complete, fsynced payload."""
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        import shutil
+
+        os.makedirs(stage, exist_ok=True)
+        marker = self._marker(stage, rank)
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+        hostdir = os.path.join(stage, f"host_{rank}")
+        tmp = hostdir + ".tmp"
+        self._write_payload(tmp, state)
+        # mid-write kill: payload staged, this host's rename not yet done
+        fault_point("checkpoint.save")
+        shutil.rmtree(hostdir, ignore_errors=True)  # stale earlier attempt
+        os.rename(tmp, hostdir)
+        if self.fsync:
+            _fsync_dir(stage)  # the rename itself, BEFORE the marker
+        mtmp = marker + ".tmp"
+        with open(mtmp, "w") as f:
+            f.write("ok\n")
+        os.replace(mtmp, marker)
+        if self.fsync:
+            _fsync_dir(stage)
+
+    def _promote(self, stage, final, world):
+        """Phase 2, leader only: wait (deadline, typed error — never a
+        hang) for every host's marker, then promote the staging dir to
+        the committed step with the journaled swap.  The rename IS the
+        cluster's single commit instant: a kill anywhere before it
+        leaves the step invisible to every reader."""
+        from dist_keras_tpu.resilience.coordination import (
+            DEFAULT_TIMEOUT_S,
+            get_coordinator,
+            wait_for_peers,
+        )
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        timeout_s = (DEFAULT_TIMEOUT_S if self.commit_timeout_s is None
+                     else self.commit_timeout_s)
+
+        def _probe(kind):
+            # liveness probes must not mask the underlying loss: a
+            # broken probe degrades the verdict to BarrierTimeout
+            def run():
+                try:
+                    return getattr(get_coordinator(), kind)()
+                except Exception:
+                    return []
+            return run
+
+        # the SAME wait-with-liveness protocol as every other
+        # rendezvous (coordination.wait_for_peers): early typed
+        # PeerLost for a host that beat and went dark, plain
+        # BarrierTimeout without evidence.  The hint matters: the most
+        # common BENIGN cause of a marker that never appears is
+        # checkpoint_dir on per-host local storage, where markers
+        # physically cannot rendezvous.
+        wait_for_peers(
+            lambda: [r for r in range(world)
+                     if not os.path.exists(self._marker(stage, r))],
+            timeout_s,
+            f"two-phase commit of {os.path.basename(stage)} (if "
+            "checkpoint_dir is per-host LOCAL storage rather than a "
+            "shared filesystem, set DK_CKPT_TWO_PHASE=0)",
+            poll_s=self.commit_poll_s,
+            stale_fn=_probe("stale_peers"))
+        # all markers landed; the torn-commit instant (every host wrote,
+        # nothing promoted) is deterministically injectable here
+        fault_point("coord.commit")
+        self._swap_in(stage, final)
+
+    def _save_multihost(self, step, state, rank, world):
+        """Two-phase commit across ``world`` hosts sharing this
+        directory.  Each host (including the leader) runs phase 1; the
+        leader alone runs phase 2.  Non-leaders return after publishing
+        their marker — the coordinated-preemption path barriers AFTER
+        save on every host, which keeps the leader alive through
+        promotion before anyone exits."""
+        final = self._step_dir(step)
+        stage = self._staging_dir(step)
+        self._inflight = os.path.basename(final)
+        try:
+            # every attempt of _save_host_once retracts this rank's own
+            # marker before touching data, so the leader can never
+            # promote around a host that is still (re)writing
+            self._retry.call(self._save_host_once, stage, rank, state)
+            if rank == 0:
+                self._promote(stage, final, world)
+                self._gc_orphans()
+        finally:
+            self._inflight = None
+        if rank == 0:
+            self._retain()
 
     def restore(self, step=None, template=None):
         """Restore ``step`` (default: latest). ``template``: a pytree with
@@ -243,7 +488,7 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        path = self._read_path(step)
+        path = self._payload_dir(self._read_path(step))
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):  # fallback-format checkpoint
             import pickle
@@ -260,6 +505,12 @@ class Checkpointer:
             f"{path}")
 
     def _retain(self):
+        # leader-only on a pod, like _gc_orphans: retention deletes are
+        # writer-side mutations of the shared directory (per-host local
+        # dirs — two-phase opted out — retain themselves)
+        rank, world = self._coord_ids()
+        if world > 1 and rank != 0 and _two_phase_enabled():
+            return
         steps = self.all_steps()
         excess = len(steps) - self.max_to_keep
         for step in steps[:max(excess, 0)]:

@@ -19,12 +19,15 @@ reference's socket plumbing stood:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
 _initialized = False
+_barrier_poisoned = None  # message of the timeout that desynced barriers
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
@@ -102,7 +105,7 @@ def local_data_slice(n_rows, process=None, count=None):
     return start, stop
 
 
-def barrier(tag="dist_keras_tpu_barrier"):
+def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
     """Block until every PROCESS reaches this point.
 
     Multi-host: ``multihost_utils.sync_global_devices`` — a named psum
@@ -111,12 +114,53 @@ def barrier(tag="dist_keras_tpu_barrier"):
     devices and could never have worked beyond one process).
     Single-process: a tiny all-device reduction with a blocking fetch.
     Returns the number of participating devices.
+
+    ``timeout_s``: deadline for the multi-host sync — a dead host used
+    to hang every survivor here forever; now the wait gives up with a
+    typed ``resilience.coordination.PeerLost`` (when heartbeat liveness
+    files under ``DK_COORD_DIR`` name the dark rank) or
+    ``BarrierTimeout``.  The single-process path has nobody to wait for
+    and keeps returning the device count immediately.
     """
     devs = jax.devices()
     if is_multi_host():
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
+        global _barrier_poisoned
+        if _barrier_poisoned:
+            # the abandoned sync from the earlier timeout may still
+            # complete on the peers — ANY further barrier (timed or
+            # not) would pair this host's op N+1 with their op N (the
+            # same desync hazard Coordinator poisoning guards against)
+            raise RuntimeError(
+                "comm.barrier is poisoned: a previous timed "
+                f"barrier gave up ({_barrier_poisoned}) and this "
+                "host's position in the collective stream is "
+                "unknowable — restart the process instead of "
+                "retrying barriers")
+
+        if timeout_s:
+            from dist_keras_tpu.resilience import coordination
+
+            def probe():
+                d = os.environ.get("DK_COORD_DIR")
+                if not d:
+                    return []
+                # evidence-only (beat once, went dark): PeerLost must
+                # never name a host that simply hasn't started beating
+                return coordination.dead_peers_at(
+                    d, jax.process_count(), require_file=True)
+
+            try:
+                coordination.with_deadline(
+                    lambda: multihost_utils.sync_global_devices(tag),
+                    timeout_s, f"barrier({tag!r})", probe)
+            except (coordination.PeerLost,
+                    coordination.BarrierTimeout) as e:
+                _barrier_poisoned = str(e)
+                raise
+        else:
+            multihost_utils.sync_global_devices(tag)
         return len(devs)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -44,6 +44,10 @@ class JobConfig:
     retries: int = 2
     retry_backoff: float = 0.5
     launch_retries: int = 0
+    # shared liveness/consensus directory (this PR): when set, every
+    # host heartbeats + coordinates preemption through it, and the
+    # launcher's Job.dead_hosts() can name a dead host
+    coord_dir: str | None = None
 
     # operator-facing JSON surface: validate types, not just names — a
     # string where a list belongs (hosts: "localhost") would otherwise
@@ -53,7 +57,8 @@ class JobConfig:
               "coordinator_port": int, "num_processes": (int, type(None)),
               "remote_root": str, "python": str,
               "retries": int, "retry_backoff": (int, float),
-              "launch_retries": int}
+              "launch_retries": int,
+              "coord_dir": (str, type(None))}
 
     @classmethod
     def from_dict(cls, d):

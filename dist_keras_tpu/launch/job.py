@@ -20,6 +20,14 @@ Nth command without a cluster), and ``Punchcard.read_manifest`` retries
 torn reads (a writer mid-rewrite is a transient JSON error, not a dead
 manifest).  A job that still fails after its retry budget keeps the
 previous semantics: nonzero rc, re-attempted on the next poll.
+
+This PR — per-host liveness: a job with ``coord_dir`` (a shared path)
+exports ``DK_COORD_DIR``/``DK_COORD_RANK``/``DK_COORD_WORLD`` to every
+host, whose training process then heartbeats
+``<coord_dir>/hb/rank_{i}`` (``resilience.coordination.Heartbeat``,
+``"job.heartbeat"`` fault point) and gains real cluster consensus for
+coordinated preemption.  ``Job.dead_hosts()`` reads the same files from
+the launcher side and names WHICH host went dark.
 """
 
 from __future__ import annotations
@@ -65,7 +73,8 @@ class Job:
     def __init__(self, secret, job_name, job_dir, entrypoint="main.py",
                  hosts=(), coordinator_port=8476, num_processes=None,
                  remote_root="~/jobs", python="python3", dry_run=False,
-                 retries=2, retry_backoff=0.5, launch_retries=0):
+                 retries=2, retry_backoff=0.5, launch_retries=0,
+                 coord_dir=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -108,6 +117,18 @@ class Job:
         self.launch_retry_policy = RetryPolicy(
             attempts=int(launch_retries) + 1, backoff=float(retry_backoff),
             jitter=0.1, retryable=(CommandFailed,))
+        # coord_dir: a SHARED path (NFS/GCS-fuse) every host and the
+        # launcher can reach.  When set, each host's env gets
+        # DK_COORD_* so the training processes' FileCoordinator
+        # heartbeats per-host liveness files there, and the launcher can
+        # report WHICH host died via dead_hosts().  One directory per
+        # job incarnation: the restart loop should rotate it (or export
+        # DK_COORD_SESSION=<attempt>).
+        if coord_dir is not None \
+                and not re.match(r"^[A-Za-z0-9._/~-]+$", str(coord_dir)):
+            raise ValueError(
+                f"coord_dir {coord_dir!r} must match [A-Za-z0-9._/~-]+")
+        self.coord_dir = coord_dir
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
@@ -154,12 +175,42 @@ class Job:
         (comm/backend.py:30)."""
         if not self.hosts:
             raise ValueError("Job needs at least one host")
-        return {
+        env = {
             "JAX_COORDINATOR_ADDRESS":
                 f"{self.hosts[0]}:{self.coordinator_port}",
             "JAX_NUM_PROCESSES": str(self.num_processes),
             "JAX_PROCESS_ID": str(pid),
         }
+        if self.coord_dir:
+            # consensus + liveness plane (resilience.coordination):
+            # rank mirrors the jax process id, so "which host died"
+            # reports map 1:1 onto self.hosts
+            env["DK_COORD_DIR"] = str(self.coord_dir)
+            env["DK_COORD_RANK"] = str(pid)
+            env["DK_COORD_WORLD"] = str(self.num_processes)
+        return env
+
+    def dead_hosts(self, stale_after_s=None):
+        """(rank, host) pairs whose liveness file under ``coord_dir`` is
+        missing or stale — the launcher-side half of dead-peer
+        detection, so an operator (or Punchcard) sees WHICH host died
+        instead of a silent pod hang.  Requires ``coord_dir`` to be a
+        path this process can read (shared filesystem); [] when no
+        liveness info exists yet.  The default stale window is the
+        workers' own (``DK_COORD_STALE_S``, 10s) so launcher and hosts
+        judge liveness by the same clock."""
+        if not self.coord_dir:
+            raise ValueError("Job has no coord_dir: no liveness files "
+                             "to inspect")
+        from dist_keras_tpu.resilience import coordination
+
+        # dead_peers_at resolves session subdir and '~' exactly the way
+        # the workers do, so launcher and hosts agree on the path
+        dead = coordination.dead_peers_at(
+            self.coord_dir, self.num_processes,
+            stale_after_s=stale_after_s)
+        return [(r, self.hosts[r] if r < len(self.hosts) else None)
+                for r in dead]
 
     def launch(self):
         """Start the entrypoint on every host under jax.distributed env."""

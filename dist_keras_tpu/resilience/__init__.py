@@ -19,12 +19,30 @@ equivalent by design and proves it with deterministic fault injection:
 - :mod:`~dist_keras_tpu.resilience.guards` — NaN/Inf sentinel over every
   fetched loss with per-trainer policy ``"raise" | "skip" | "halt"``,
   counted in ``trainer.metrics``.
+- :mod:`~dist_keras_tpu.resilience.coordination` — cluster-wide failure
+  consensus (``any_flag`` / ``agree_min`` / ``all_ok`` / deadline
+  ``barrier``) over psum/allgather when multi-host, a deterministic
+  filesystem rendezvous under ``DK_COORD_DIR``, or trivially local;
+  typed :class:`PeerLost` / :class:`BarrierTimeout` instead of hangs,
+  heartbeat liveness files for dead-peer attribution.
 
 See the README "Failure semantics" section for the retried / resumed /
-fatal taxonomy.
+fatal taxonomy and the multi-host preemption matrix.
 """
 
-from dist_keras_tpu.resilience import faults, guards, preemption, retry
+from dist_keras_tpu.resilience import (
+    coordination,
+    faults,
+    guards,
+    preemption,
+    retry,
+)
+from dist_keras_tpu.resilience.coordination import (
+    BarrierTimeout,
+    FileCoordinator,
+    PeerLost,
+    get_coordinator,
+)
 from dist_keras_tpu.resilience.faults import (
     FaultInjected,
     armed,
@@ -36,7 +54,8 @@ from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.resilience.retry import RetryPolicy, retry_call
 
 __all__ = [
-    "faults", "guards", "preemption", "retry",
-    "FaultInjected", "armed", "fault_point", "inject",
+    "coordination", "faults", "guards", "preemption", "retry",
+    "BarrierTimeout", "FaultInjected", "FileCoordinator", "PeerLost",
+    "armed", "fault_point", "get_coordinator", "inject",
     "NonFiniteLossError", "Preempted", "RetryPolicy", "retry_call",
 ]

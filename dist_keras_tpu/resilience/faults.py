@@ -19,6 +19,18 @@ recoverable I/O seam in the framework passes through a named
 - ``"step.loss"`` — over each fetched host loss array in the trainers'
   ``ChunkRunner`` (a corrupt-fault plants a NaN to exercise the
   ``nan_policy`` sentinel without poisoning device math).
+- ``"coord.flag"`` / ``"coord.agree"`` / ``"coord.barrier"`` — the
+  cluster-consensus primitives (``resilience.coordination``): the
+  boundary preemption vote, the save-step agreement and the pre-exit
+  barrier each fail at an exact call count.
+- ``"coord.commit"`` — between the last ``host-{i}.ok`` marker landing
+  and the leader's promotion rename in a multi-host checkpoint
+  (``checkpoint.Checkpointer``): raising here IS the torn two-phase
+  commit.
+- ``"job.heartbeat"`` — each liveness-file beat
+  (``coordination.Heartbeat``): a raise silences the thread, so a host
+  "dies" at a deterministic beat count and its peers' next deadline
+  raises a typed ``PeerLost`` naming it.
 
 Faults are scheduled on the point's CALL COUNT (0-based), so a test kills
 exactly the Nth save or fails exactly the first two rsyncs — no timing, no
@@ -173,11 +185,23 @@ def _parse_env_entry(entry):
     # fail LOUDLY at parse time, naming the entry — a malformed schedule
     # surfacing lazily from the first fault_point call deep inside
     # training would be much harder to trace back to the env var
-    if m is None or not entry or m.group("point").endswith("@"):
+    # '@' in the resolved point name means the @at[xN] suffix did not
+    # parse (e.g. "checkpoint.save@x2") — arming it as a literal name
+    # would make the schedule silently never fire; no real point name
+    # contains '@'
+    if m is None or not entry or "@" in m.group("point"):
         raise ValueError(
             f"malformed DK_FAULTS entry {entry!r}: expected "
             "point[@at[xN]][:k=v,...]")
-    exc = _EXC_NAMES.get(opts.get("exc", "FaultInjected"), FaultInjected)
+    exc_name = opts.get("exc", "FaultInjected")
+    if exc_name in ("PeerLost", "BarrierTimeout"):
+        # lazy: coordination imports this module at its top level, so
+        # the reverse import must stay inside the parse path
+        from dist_keras_tpu.resilience import coordination
+
+        exc = getattr(coordination, exc_name)
+    else:
+        exc = _EXC_NAMES.get(exc_name, FaultInjected)
     value = opts.get("value")
     if value is not None:
         value = float(value)

@@ -23,9 +23,12 @@ handlers also fire on the graceful path because an uncaught
 :class:`Preempted` is a ``SystemExit`` — atexit hooks run on the way
 out.
 
-Single-host scope note: each process checkpoints its own step counter;
-COORDINATED multi-host preemption (all hosts agreeing on the save step
-before any of them exits) is an open ROADMAP item.
+Multi-host scope: the flag set here is PER-PROCESS; the dispatch loop
+(``trainers/chunking.py``) turns it into a CLUSTER decision by voting
+``coordination.any_flag`` at every chunk boundary, agreeing on one save
+step (``agree_min``), committing it two-phase (``checkpoint.py``), and
+barriering before any host raises :class:`Preempted` — so the scheduler
+restarts the whole pod against one fully-committed checkpoint.
 """
 
 from __future__ import annotations
@@ -91,10 +94,19 @@ def _handler(signum, frame):
     os.kill(os.getpid(), signum)
 
 
-def install(signals=(signal.SIGTERM, signal.SIGINT)):
-    """Install the graceful handlers.  Returns True when installed; False
-    from a non-main thread (signal handlers are main-thread-only — the
-    caller then simply runs without a graceful window).
+def install(signals=(signal.SIGTERM, signal.SIGINT), strict=True):
+    """Install the graceful handlers.  Returns True when installed.
+
+    Signal handlers are MAIN-THREAD-ONLY (a CPython runtime rule —
+    ``signal.signal`` raises off it).  That used to surface as an
+    obscure ``ValueError: signal only works in main thread of the main
+    interpreter`` — or worse, as a silent False that also swallowed the
+    unrelated ValueError of an invalid signal number.  Now the thread is
+    detected EXPLICITLY: off the main thread, ``strict=True`` (the
+    default) raises a clear, actionable error, while ``strict=False``
+    (what the dispatch loop passes) returns False and the caller runs
+    without a graceful window.  Any other ``signal.signal`` error (bad
+    signal number, unsupported platform signal) propagates untouched.
 
     A request already pending is PRESERVED, not reset: a SIGTERM that
     landed between two trainer runs (after A's last boundary check,
@@ -102,13 +114,19 @@ def install(signals=(signal.SIGTERM, signal.SIGINT)):
     scheduler's grace clock is ticking regardless.  Code that
     deliberately continues after catching :class:`Preempted` must call
     :func:`clear` first."""
-    try:
-        for s in signals:
-            prev = signal.signal(s, _handler)
-            if prev is not _handler:  # re-install keeps the ORIGINAL prev
-                _prev[s] = prev
-    except ValueError:  # not the main thread
+    if threading.current_thread() is not threading.main_thread():
+        if strict:
+            raise RuntimeError(
+                "preemption.install() must run on the MAIN thread: "
+                "Python only allows signal handlers there "
+                "(signal.signal raises from any other thread).  Run "
+                "the trainer on the main thread, or pass strict=False "
+                "to proceed without a graceful preemption window.")
         return False
+    for s in signals:
+        prev = signal.signal(s, _handler)
+        if prev is not _handler:  # re-install keeps the ORIGINAL prev
+            _prev[s] = prev
     return True
 
 

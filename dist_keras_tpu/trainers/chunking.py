@@ -21,11 +21,12 @@ Python step.  Here the no-hooks case stays ONE compiled dispatch.
 
 from __future__ import annotations
 
+import signal
 import time
 
 import numpy as np
 
-from dist_keras_tpu.resilience import preemption
+from dist_keras_tpu.resilience import coordination, preemption
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.guards import check_losses
 from dist_keras_tpu.resilience.preemption import Preempted
@@ -310,14 +311,49 @@ class ChunkRunner:
                 self._halt = True
 
         # graceful preemption window: handlers only set a flag; the loop
-        # notices it at the next chunk boundary below
-        installed = tr.handle_preemption and preemption.install()
+        # notices it at the next chunk boundary below.  Off the main
+        # thread there is no graceful window (strict=False) — signal
+        # handlers are main-thread-only, the run proceeds uninstalled.
+        installed = (tr.handle_preemption
+                     and preemption.install(strict=False))
+        # cluster consensus (tentpole, ISSUE 2): on a pod the SIGTERM
+        # reaches hosts at different instants, so a LOCAL flag is not
+        # enough — every chunk boundary piggybacks an any_flag vote, and
+        # all hosts agree on one save step and exit Preempted together.
+        # Single-process this is the trivial LocalCoordinator (its only
+        # cost is the "coord.flag" fault point's dict lookup, which is
+        # also what makes the whole path injectable without a cluster).
+        # The coordinator is resolved REGARDLESS of handle_preemption:
+        # the NaN-halt verdict below must be cluster-wide on ANY
+        # multi-host run — a host halting alone would strand its peers'
+        # next two-phase save against a marker that never comes.  Only
+        # the preemption VOTE is gated on handle_preemption (a config
+        # every host shares, so the collective op order stays SPMD).
+        coord = coordination.get_coordinator()
         tr.record_training_start()
         t_mark = time.time()
         try:
             for i, K in enumerate(self.plan):
                 sig = (preemption.requested()
                        if tr.handle_preemption else None)
+                if tr.handle_preemption:
+                    # boundary vote: did ANY host see the signal?  A
+                    # host whose own flag is clear adopts SIGTERM — its
+                    # scheduler's signal is merely in flight.
+                    if coord.any_flag(sig is not None):
+                        sig = signal.SIGTERM if sig is None else sig
+                    if sig is not None and coord.world > 1:
+                        agreed = coord.agree_min(units_done)
+                        if agreed != units_done:  # pragma: no cover
+                            # identical plans + the same vote boundary
+                            # make this impossible unless hosts diverged
+                            # (NOT a lost peer — PeerLost is reserved
+                            # for heartbeat-proven deaths)
+                            raise RuntimeError(
+                                f"coordinated save step disagreement: "
+                                f"this host at {units_done}, cluster "
+                                f"min {agreed} — hosts ran different "
+                                "chunk plans")
                 if sig is not None:
                     # checkpoint at the boundary, then exit 128+signum
                     # (Preempted is a SystemExit) so the scheduler
@@ -327,8 +363,22 @@ class ChunkRunner:
                     # state must NOT be persisted here either.
                     while pending:
                         _retire_one()
+                    if coord.world > 1:
+                        # the halt verdict must be CLUSTER-wide too: a
+                        # NaN seen by one host only would otherwise make
+                        # it skip the save while its peers enter the
+                        # two-phase commit — the leader would then wait
+                        # out the whole deadline on a marker that never
+                        # comes.  Either every host saves or none does.
+                        self._halt = coord.any_flag(self._halt)
                     saved = (None if self._halt
                              else self._preempt_save(units_done, state_fn))
+                    if coord.world > 1:
+                        # every host's save (incl. the leader's
+                        # promotion) lands before ANY host exits — the
+                        # scheduler restarts a pod whose checkpoint is
+                        # fully committed, never torn
+                        coord.barrier("preempt_exit")
                     raise Preempted(sig, saved_step=saved)
                 data = (self.feed.get(i) if self.feed is not None
                         else resident_data)
@@ -343,10 +393,16 @@ class ChunkRunner:
                     while len(pending) > 1:
                         _retire_one()
                     self.feed.prefetch(i + 1)
+                multi = coord.world > 1
+                # multi-host: a locally-tripped halt must NOT cut a
+                # boundary only this host sees — every consensus op has
+                # to happen at the same loop position on every host
+                # (SPMD discipline), so halt waits for the next NATURAL
+                # boundary and is put to a cluster vote there
                 boundary = (units_done % self.per_epoch == 0
                             or i == len(self.plan) - 1
                             or self._ckpt_due(units_done)
-                            or self._halt)
+                            or (self._halt and not multi))
                 acc_samples += self.samples_per_unit * K
                 if not boundary:
                     continue
@@ -356,6 +412,12 @@ class ChunkRunner:
                 # user callbacks) stays OUTSIDE the clock
                 while pending:
                     _retire_one()
+                if multi:
+                    # cluster halt verdict: one host's NaN halts the
+                    # whole pod together (or nobody) — an uncoordinated
+                    # break here would leave the peers blocking in their
+                    # next vote until the deadline
+                    self._halt = coord.any_flag(self._halt)
                 # save BEFORE user callbacks run: a callback that dies
                 # (preemption simulation) must not lose the chunk — but
                 # NEVER persist a halted (diverged) run's state

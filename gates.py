@@ -20,7 +20,16 @@ on the canonical 8-virtual-device CPU harness (tests/conftest.py — the
 host a 4- or 8-worker mesh).  The recorded ``environment`` block says
 exactly what ran where.
 
+This PR adds the COORDINATION gate: a two-process FileCoordinator job
+run four times — clean coordinated preemption, then with each
+``coord.*`` fault armed (``coord.flag``, ``coord.barrier``,
+``coord.commit``) — asserting the cluster always converges to either a
+fully-committed checkpoint or a TYPED error on every rank, **never a
+hang** (each scenario runs under the tier's subprocess timeout, so a
+wedged rendezvous fails the gate instead of wedging CI).
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
+                        [--coordination-only]
 """
 
 from __future__ import annotations
@@ -34,6 +43,142 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Mimics the dispatch loop's boundary choreography (chunking.py) with a
+# real FileCoordinator + two-phase Checkpointer but no training, so one
+# scenario runs in seconds: vote -> agree -> save -> barrier -> exit
+# 128+SIGTERM.  Faults are armed per rank via DK_FAULTS in the parent.
+_COORD_WORKER = r"""
+import os, sys, signal
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank, coord_dir, ck_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["DK_COORD_DIR"] = coord_dir
+os.environ["DK_COORD_RANK"] = str(rank)
+os.environ["DK_COORD_WORLD"] = "2"
+os.environ["DK_COORD_TIMEOUT_S"] = "30"
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.resilience import coordination, preemption
+from dist_keras_tpu.resilience.preemption import Preempted
+from dist_keras_tpu.checkpoint import Checkpointer
+
+coord = coordination.get_coordinator()
+ckptr = Checkpointer(ck_dir, commit_timeout_s=30)
+units = 0
+for i in range(6):
+    if rank == 0 and i == 3:   # the scheduler's SIGTERM: ONE host only
+        preemption.request(signal.SIGTERM)
+    sig = preemption.requested()
+    if coord.any_flag(sig is not None):
+        step = coord.agree_min(units)
+        ckptr.save(step, {"units": np.int64(step)})
+        coord.barrier("preempt_exit")
+        print("PREEMPTED", rank, "step", step, flush=True)
+        raise Preempted(signal.SIGTERM, saved_step=step)
+    units += 1
+print("NOT_PREEMPTED", rank, flush=True)
+sys.exit(1)
+"""
+
+# per-scenario DK_FAULTS schedules: {scenario: (rank0_faults, rank1_faults)}
+_COORD_SCENARIOS = {
+    "clean": ("", ""),
+    "flag_fault": ("coord.flag@2", ""),
+    "barrier_fault": ("", "coord.barrier@0"),
+    "commit_fault": ("coord.commit@0", ""),
+}
+_TYPED_ERRORS = ("PeerLost", "BarrierTimeout", "FaultInjected",
+                 "PREEMPTED")
+
+
+def run_coordination_gate(timeout=180):
+    """-> gate record.  Passes iff every scenario's BOTH ranks terminate
+    inside the timeout (never a hang) and end in either a coordinated
+    preemption against a fully-committed checkpoint (the clean run) or
+    a typed error with NO torn commit visible to readers."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_coord_gate_")
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_COORD_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    t0 = time.time()
+    try:
+        for name, (f0, f1) in _COORD_SCENARIOS.items():
+            coord_dir = os.path.join(work, name, "coord")
+            ck_dir = os.path.join(work, name, "ck")
+            procs = []
+            for rank, fl in ((0, f0), (1, f1)):
+                env = dict(base_env)
+                if fl:
+                    env["DK_FAULTS"] = fl
+                procs.append(subprocess.Popen(
+                    [sys.executable, script, str(rank), coord_dir,
+                     ck_dir],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    env=env, text=True))
+            outs, hung = [], False
+            for p in procs:
+                try:
+                    outs.append(p.communicate(timeout=timeout)[0])
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs.append(p.communicate()[0])
+                    hung = True
+            if hung:
+                failures.append(f"{name}: HANG (killed at {timeout}s)")
+                continue
+            rcs = [p.returncode for p in procs]
+            committed = sorted(
+                int(m.group(1)) for m in
+                (re.match(r"^step_(\d+)$", n)
+                 for n in (os.listdir(ck_dir)
+                           if os.path.isdir(ck_dir) else []))
+                if m)
+            if name == "clean":
+                # the coordinated exit: both 128+SIGTERM, ONE agreed
+                # fully-committed step (the vote fires at i=3 -> unit 3)
+                if rcs != [143, 143]:
+                    failures.append(f"clean: rcs={rcs}")
+                if committed != [3]:
+                    failures.append(f"clean: committed={committed}")
+            else:
+                # a fault anywhere must surface as a TYPED error on the
+                # faulted rank and a typed verdict (PeerLost/timeout)
+                # on the survivor — and commit_fault's torn staging
+                # must be invisible to readers
+                for rank, (rc, o) in enumerate(zip(rcs, outs)):
+                    if rc == 0:
+                        failures.append(f"{name}: rank {rank} exited 0")
+                    if not any(t in o for t in _TYPED_ERRORS):
+                        failures.append(
+                            f"{name}: rank {rank} died untyped: "
+                            f"{o[-300:]}")
+                if name == "commit_fault" and committed:
+                    failures.append(
+                        f"commit_fault: torn save visible: {committed}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "coordination_faults",
+        "metric": "converged_or_typed_error",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "scenarios": sorted(_COORD_SCENARIOS),
+        "failures": failures,
+    }
 
 
 def run_gates(fast=False, timeout=3 * 3600):
@@ -64,9 +209,18 @@ def main():
     ap.add_argument("--round", type=int,
                     default=int(os.environ.get("GRAFT_ROUND", 5)))
     ap.add_argument("--out", default=None)
+    ap.add_argument("--coordination-only", action="store_true",
+                    help="run just the coordination fault gate and "
+                         "print its record (no accuracy gates)")
     args = ap.parse_args()
 
+    coord_gate = run_coordination_gate()
+    if args.coordination_only:
+        print(json.dumps(coord_gate, indent=1))
+        return 0 if coord_gate["passed"] else 1
+
     res = run_gates(fast=args.fast)
+    res["gates"].append(coord_gate)
     import platform
 
     doc = {

@@ -1,0 +1,950 @@
+"""Coordinated multi-host preemption (ISSUE 2): cluster-wide failure
+consensus, two-phase checkpoint commit, dead-peer detection.
+
+Fast tier: the consensus primitives run through real FileCoordinators
+(two ranks driven by threads or sequentially in one process — the
+protocol is pure filesystem, no collectives needed) and the two-phase
+commit runs through two Checkpointer identities sharing a directory,
+with every failure mode injected at a named fault point.  The slow tier
+is the real thing: two processes, one SIGTERM, one agreed checkpoint,
+bit-equal resume.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.resilience import faults, preemption
+from dist_keras_tpu.resilience.coordination import (
+    BarrierTimeout,
+    FileCoordinator,
+    Heartbeat,
+    LocalCoordinator,
+    PeerLost,
+    dead_peers,
+)
+from dist_keras_tpu.resilience import coordination
+from dist_keras_tpu.resilience.preemption import Preempted
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    preemption.clear()
+    coordination.reset()
+    yield
+    faults.clear()
+    preemption.clear()
+    preemption.restore()
+    coordination.reset()
+
+
+# ---------------------------------------------------------------------------
+# consensus primitives
+# ---------------------------------------------------------------------------
+def test_local_coordinator_is_trivial():
+    c = LocalCoordinator()
+    assert c.world == 1 and c.rank == 0
+    assert c.any_flag(False) is False
+    assert c.any_flag(True) is True
+    assert c.all_ok(True) is True
+    assert c.all_ok(False) is False
+    assert c.agree_min(7) == 7
+    assert c.agree_max(7) == 7
+    assert c.barrier() == 1
+
+
+def test_coordination_primitives_are_fault_points():
+    c = LocalCoordinator()
+    with faults.armed("coord.flag"):
+        with pytest.raises(faults.FaultInjected):
+            c.any_flag(False)
+    with faults.armed("coord.agree"):
+        with pytest.raises(faults.FaultInjected):
+            c.agree_min(1)
+    with faults.armed("coord.barrier"):
+        with pytest.raises(faults.FaultInjected):
+            c.barrier()
+
+
+def _pair(tmp_path, fn, timeout=20.0):
+    """Drive the SAME op sequence on two FileCoordinator ranks from two
+    threads; returns (rank0 results, rank1 results)."""
+    cs = [FileCoordinator(str(tmp_path), rank=r, world=2,
+                          heartbeat=False) for r in (0, 1)]
+    out, errs = {}, {}
+
+    def run(r):
+        try:
+            out[r] = fn(cs[r], r)
+        except BaseException as e:  # surfaced below, not swallowed
+            errs[r] = e
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in ts), "rendezvous deadlocked"
+    if errs:
+        raise next(iter(errs.values()))
+    return out[0], out[1]
+
+
+def test_file_coordinator_consensus_matrix(tmp_path):
+    """any_flag = OR, all_ok = AND, agree_min/max = min/max, barrier
+    returns the participant count — identical verdict on every rank."""
+    def ops(c, r):
+        return (c.any_flag(r == 0, timeout_s=15),   # one flagged -> True
+                c.any_flag(False, timeout_s=15),    # none flagged -> False
+                c.all_ok(True, timeout_s=15),       # all ok -> True
+                c.all_ok(r == 1, timeout_s=15),     # one failed -> False
+                c.agree_min(3 if r == 0 else 9, timeout_s=15),
+                c.agree_max(3 if r == 0 else 9, timeout_s=15),
+                c.barrier(timeout_s=15))
+
+    r0, r1 = _pair(tmp_path, ops)
+    assert r0 == r1 == (True, False, True, False, 3, 9, 2)
+
+
+def test_file_coordinator_timeout_is_typed_not_a_hang(tmp_path):
+    """Rank 1 never shows up and there is no liveness info: the verdict
+    is BarrierTimeout naming the missing rank — never an infinite
+    wait."""
+    c = FileCoordinator(str(tmp_path), rank=0, world=2, heartbeat=False)
+    t0 = time.monotonic()
+    with pytest.raises(BarrierTimeout, match=r"\[1\]"):
+        c.any_flag(True, timeout_s=0.3)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_never_started_peer_is_a_timeout_not_a_death(tmp_path):
+    """A rank with NO liveness trace (never beat — maybe still
+    importing jax) is absence of evidence: the verdict stays
+    BarrierTimeout even though rank 0's own heartbeat created the hb
+    directory.  PeerLost is reserved for beat-then-went-dark."""
+    c = FileCoordinator(str(tmp_path), rank=0, world=2,
+                        heartbeat_interval_s=0.05, stale_after_s=60.0)
+    try:
+        with pytest.raises(BarrierTimeout, match=r"\[1\]"):
+            c.barrier(timeout_s=0.3)
+    finally:
+        c.close()
+
+
+def test_stale_peer_surfaces_early_not_at_the_deadline(tmp_path):
+    """A peer that once BEAT and went dark is provably lost: the wait
+    raises PeerLost within ~a probe interval, NOT after the full
+    deadline (here 60s — the test finishing fast IS the assertion)."""
+    c = FileCoordinator(str(tmp_path), rank=0, world=2,
+                        heartbeat_interval_s=0.05, stale_after_s=0.2)
+    try:
+        # rank 1 lived once, then went dark (backdated heartbeat)
+        Heartbeat(str(tmp_path), rank=1).beat_once()
+        old = time.time() - 60
+        os.utime(os.path.join(str(tmp_path), "hb", "rank_1"),
+                 (old, old))
+        t0 = time.monotonic()
+        with pytest.raises(PeerLost) as ei:
+            c.agree_min(5, timeout_s=60.0)
+        assert ei.value.ranks == (1,)
+        assert time.monotonic() - t0 < 10.0  # early, not the deadline
+    finally:
+        c.close()
+
+
+def test_heartbeat_fault_silences_the_host(tmp_path):
+    """An armed "job.heartbeat" raise stops the beat thread — the host
+    goes dark at a deterministic beat count and dead_peers reports
+    it."""
+    hb = Heartbeat(str(tmp_path), rank=0, interval_s=0.01)
+    faults.inject("job.heartbeat", at=1, times=999)
+    hb.start()  # beat #0 lands; beat #1 raises inside the thread
+    try:
+        assert dead_peers(str(tmp_path), 1, stale_after_s=60) == []
+        time.sleep(0.4)
+        assert dead_peers(str(tmp_path), 1, stale_after_s=0.2) == [0]
+    finally:
+        hb.stop()
+
+
+def test_heartbeat_survives_transient_write_errors(tmp_path):
+    """A transient liveness-file error (NFS blip) must NOT silence a
+    healthy host permanently — only the injected FaultInjected death
+    does.  One missed beat hides inside the stale window."""
+    hb = Heartbeat(str(tmp_path), rank=0, interval_s=0.01)
+    faults.inject("job.heartbeat", at=1, times=1, exc=OSError)
+    hb.start()
+    try:
+        time.sleep(0.3)  # the OSError beat passes, later beats land
+        assert dead_peers(str(tmp_path), 1, stale_after_s=0.15) == []
+    finally:
+        hb.stop()
+
+
+def test_timed_out_coordinator_is_poisoned(tmp_path):
+    """After a collective timeout this process's position in the op
+    stream is unknowable: the next collective must refuse with an
+    actionable error, not silently match op N's answers to op N+1."""
+    c = FileCoordinator(str(tmp_path), rank=0, world=2, heartbeat=False)
+    with pytest.raises(BarrierTimeout):
+        c.any_flag(True, timeout_s=0.2)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        c.agree_min(1, timeout_s=0.2)
+
+
+def test_dead_peers_without_liveness_info_is_empty(tmp_path):
+    # no hb dir at all = absence of evidence, not evidence of death
+    assert dead_peers(str(tmp_path), 4, stale_after_s=0.0) == []
+
+
+def test_env_selected_file_coordinator(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_COORD_DIR", str(tmp_path))
+    monkeypatch.setenv("DK_COORD_RANK", "0")
+    monkeypatch.setenv("DK_COORD_WORLD", "1")
+    monkeypatch.setenv("DK_COORD_SESSION", "attempt3")
+    coordination.reset()
+    c = coordination.get_coordinator()
+    assert isinstance(c, FileCoordinator)
+    assert (c.rank, c.world) == (0, 1)
+    # incarnation isolation: everything lives under the session subdir
+    assert c.directory == str(tmp_path / "attempt3")
+    assert coordination.rank() == 0 and coordination.world() == 1
+    assert c.any_flag(True) is True  # world 1: immediate
+    assert coordination.get_coordinator() is c  # cached (op counter!)
+
+
+# ---------------------------------------------------------------------------
+# two-phase checkpoint commit
+# ---------------------------------------------------------------------------
+def _ckptr(tmp_path, rank, world, **kw):
+    from dist_keras_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "ck"), rank=rank, world=world, **kw)
+    ck._retry.sleep = lambda s: None
+    return ck
+
+
+def _state(rank, step):
+    return {"a": np.arange(4.0) + 10 * rank + step, "r": np.int32(rank)}
+
+
+def test_two_phase_commit_promotes_only_when_all_markers_land(tmp_path):
+    """Phase 1 alone (a non-leader's save) publishes data + marker but
+    NO step: latest_step stays empty until the leader, finding every
+    marker, promotes the staging directory."""
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck1.save(5, _state(1, 5))
+    # staged, marked — but invisible to every reader
+    stage = os.path.join(ck1.directory, "step_00000005.mh")
+    assert os.path.isdir(os.path.join(stage, "host_1"))
+    assert os.path.exists(os.path.join(stage, "host-1.ok"))
+    assert ck1.all_steps() == []
+    assert ck1.latest_step() is None
+
+    ck0 = _ckptr(tmp_path, rank=0, world=2)
+    ck0.save(5, _state(0, 5))  # leader: marker set complete -> promote
+    assert not os.path.exists(stage)
+    assert ck0.all_steps() == [5]
+    # each rank restores ITS OWN payload from the promoted step
+    for rank, ck in ((0, ck0), (1, ck1)):
+        step, got = ck.restore(template=_state(rank, 5))
+        assert step == 5
+        np.testing.assert_array_equal(got["a"], _state(rank, 5)["a"])
+        assert int(got["r"]) == rank
+
+
+def test_torn_commit_is_invisible_and_resume_falls_back(tmp_path):
+    """The acceptance scenario: a save killed between the last marker
+    landing and the leader's promotion rename ("coord.commit") leaves a
+    staging dir NO reader counts; resume falls back to the last fully
+    committed step on every rank."""
+    ck0 = _ckptr(tmp_path, rank=0, world=2)
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck1.save(2, _state(1, 2))
+    ck0.save(2, _state(0, 2))  # step 2 fully committed
+    ck1.save(4, _state(1, 4))
+    with faults.armed("coord.commit"):
+        with pytest.raises(faults.FaultInjected):
+            ck0.save(4, _state(0, 4))  # dies at the promotion instant
+    # torn: all data + markers staged, nothing promoted
+    assert os.path.isdir(os.path.join(ck0.directory, "step_00000004.mh"))
+    for ck, rank in ((_ckptr(tmp_path, rank=0, world=2), 0),
+                     (_ckptr(tmp_path, rank=1, world=2), 1)):
+        assert ck.all_steps() == [2]      # the torn step does NOT count
+        assert ck.latest_step() == 2
+        step, got = ck.restore(template=_state(rank, 2))
+        assert step == 2                  # fell back, bit-exact
+        np.testing.assert_array_equal(got["a"], _state(rank, 2)["a"])
+
+    # the retried save at the same step supersedes the torn staging
+    # (each rank retracts its own stale marker before rewriting)
+    ck1b = _ckptr(tmp_path, rank=1, world=2)
+    ck1b.save(4, _state(1, 4))
+    ck0b = _ckptr(tmp_path, rank=0, world=2)
+    ck0b.save(4, _state(0, 4))
+    assert ck0b.all_steps() == [2, 4]
+    step, got = ck0b.restore(template=_state(0, 4))
+    assert step == 4
+
+
+def test_leader_times_out_typed_when_marker_never_lands(tmp_path):
+    """A host whose marker never lands and about which there is NO
+    liveness evidence: the leader's promotion wait raises a typed
+    BarrierTimeout naming the missing rank — PeerLost is reserved for
+    heartbeat-proven deaths, and neither is ever an indefinite hang."""
+    ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(BarrierTimeout, match=r"\[1\]"):
+        ck0.save(7, _state(0, 7))
+    assert time.monotonic() - t0 < 5.0
+    assert ck0.all_steps() == []  # nothing half-committed
+
+
+def test_leader_peer_lost_with_heartbeat_evidence(tmp_path, monkeypatch):
+    """Same missing marker, but liveness files PROVE rank 1 died (beat
+    once, went stale): the verdict upgrades to PeerLost naming it,
+    raised early — not at the deadline."""
+    monkeypatch.setenv("DK_COORD_DIR", str(tmp_path / "coord"))
+    monkeypatch.setenv("DK_COORD_RANK", "0")
+    monkeypatch.setenv("DK_COORD_WORLD", "2")
+    monkeypatch.setenv("DK_COORD_STALE_S", "0.2")
+    coordination.reset()
+    Heartbeat(str(tmp_path / "coord"), rank=1).beat_once()
+    old = time.time() - 60
+    os.utime(os.path.join(str(tmp_path / "coord"), "hb", "rank_1"),
+             (old, old))
+    ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(PeerLost) as ei:
+        ck0.save(7, _state(0, 7))
+    assert ei.value.ranks == (1,)
+    assert time.monotonic() - t0 < 10.0  # early, not the 30s deadline
+
+
+def test_mid_write_kill_on_one_host_never_commits(tmp_path):
+    """checkpoint.save armed on a non-leader: its payload write dies
+    BEFORE the marker, so the cluster can never promote the step — the
+    leader gets a typed timeout, readers see nothing."""
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    with faults.armed("checkpoint.save"):
+        with pytest.raises(faults.FaultInjected):
+            ck1.save(3, _state(1, 3))
+    stage = os.path.join(ck1.directory, "step_00000003.mh")
+    assert not os.path.exists(os.path.join(stage, "host-1.ok"))
+    ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=0.3)
+    with pytest.raises(BarrierTimeout):  # no liveness evidence here
+        ck0.save(3, _state(0, 3))
+    assert ck0.all_steps() == []
+
+
+def test_missing_own_payload_in_committed_step_is_an_error(tmp_path):
+    """A committed step missing THIS rank's payload is corrupt:
+    restoring another host's state (per-host optimizer slots, staleness
+    counters) would silently diverge the run.  A rank beyond the
+    writing world (larger-world resume) still reads the leader's
+    replica."""
+    import shutil
+
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck0 = _ckptr(tmp_path, rank=0, world=2)
+    ck1.save(4, _state(1, 4))
+    ck0.save(4, _state(0, 4))
+    shutil.rmtree(os.path.join(ck0.directory, "step_00000004",
+                               "host_1"))
+    with pytest.raises(RuntimeError, match="host_1"):
+        ck1.restore(template=_state(1, 4))
+    # rank 0's own payload still restores
+    step, got = ck0.restore(template=_state(0, 4))
+    assert step == 4
+    # a rank beyond the writing world falls back to the leader replica
+    ck5 = _ckptr(tmp_path, rank=5, world=6)
+    step, got = ck5.restore(template=_state(0, 4))
+    assert int(got["r"]) == 0
+
+
+def test_multihost_gc_is_leader_only(tmp_path):
+    """Two hosts must not race a third's in-flight rename: only rank 0
+    sweeps orphans (and prunes retention) in multi-host mode."""
+    ck0 = _ckptr(tmp_path, rank=0, world=2)
+    orphan = os.path.join(ck0.directory, "step_00000009.tmp")
+    os.makedirs(orphan)
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck1._gc_orphans()
+    assert os.path.isdir(orphan)  # non-leader: hands off
+    ck0._gc_orphans()
+    assert not os.path.exists(orphan)  # leader sweeps
+
+    # single-host GC behavior is unchanged (regression guard)
+    ck = _ckptr(tmp_path, rank=0, world=1)
+    os.makedirs(orphan)
+    ck._gc_orphans()
+    assert not os.path.exists(orphan)
+
+
+def test_leader_gc_spares_a_peers_newer_inflight_staging(tmp_path):
+    """The leader's post-promote sweep must not destroy a fast peer's
+    in-flight phase-1 staging for a NEWER step (saves outside the
+    lockstepped boundary loop are not synchronized); staging provably
+    superseded (older than the step being committed) is still swept."""
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck0 = _ckptr(tmp_path, rank=0, world=2)
+    # a torn OLD staging (step 1) and a peer's in-flight NEWER one
+    # (step 9, data + marker already landed, leader not there yet)
+    os.makedirs(os.path.join(ck0.directory, "step_00000001.mh"))
+    ck1.save(9, _state(1, 9))
+    newer = os.path.join(ck0.directory, "step_00000009.mh")
+    assert os.path.isdir(newer)
+    # the cluster commits step 5
+    ck1.save(5, _state(1, 5))
+    ck0.save(5, _state(0, 5))
+    assert ck0.all_steps() == [5]
+    assert not os.path.exists(
+        os.path.join(ck0.directory, "step_00000001.mh"))  # swept
+    assert os.path.exists(os.path.join(newer, "host-1.ok"))  # spared
+    # and the spared staging completes into a real commit
+    ck0.save(9, _state(0, 9))
+    assert ck0.all_steps() == [5, 9]
+
+
+def test_coord_env_identity_is_required_not_defaulted(
+        tmp_path, monkeypatch):
+    """DK_COORD_DIR without DK_COORD_WORLD must be an actionable error
+    everywhere — a silent world=1 would turn the two-phase commit OFF
+    on the very directory the operator configured for it."""
+    monkeypatch.setenv("DK_COORD_DIR", str(tmp_path))
+    monkeypatch.delenv("DK_COORD_RANK", raising=False)
+    monkeypatch.delenv("DK_COORD_WORLD", raising=False)
+    with pytest.raises(ValueError, match="DK_COORD_RANK"):
+        coordination.rank()
+    with pytest.raises(ValueError, match="DK_COORD_WORLD"):
+        coordination.world()
+
+
+def test_single_host_save_layout_unchanged(tmp_path):
+    """world=1 keeps the round-6 layout byte-for-byte: no host_ subdir,
+    no markers — old checkpoints stay readable, new ones stay readable
+    by old code."""
+    ck = _ckptr(tmp_path, rank=0, world=1)
+    ck.save(1, {"a": np.ones(3)})
+    names = sorted(os.listdir(os.path.join(ck.directory,
+                                           "step_00000001")))
+    assert not any(n.startswith("host") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# the coordinated boundary loop (fake coordinator, real ChunkRunner)
+# ---------------------------------------------------------------------------
+class _FakeTrainer:
+    handle_preemption = True
+    nan_policy = None
+    nonfinite_steps = 0
+    callbacks = []
+
+    def __init__(self, ckdir):
+        from dist_keras_tpu.checkpoint import Checkpointer
+
+        # explicit world=1: the two-phase protocol is exercised above;
+        # here the subject is the LOOP's consensus choreography
+        self._ck = Checkpointer(ckdir, rank=0, world=1)
+
+    def _checkpointer_or_none(self):
+        return self._ck
+
+    def record_training_start(self):
+        pass
+
+    def record_training_end(self):
+        pass
+
+    def _emit_epoch_end(self, *a):
+        pass
+
+
+def _run_plan(tmp_path, coord, request_at=None):
+    from dist_keras_tpu.trainers.chunking import ChunkRunner
+
+    tr = _FakeTrainer(str(tmp_path / "ck"))
+    runner = ChunkRunner(tr, plan=[2, 2, 2], start=0, total=6,
+                        per_epoch=2, samples_per_unit=1, cadence=None)
+
+    def dispatch(i, K, units_done, data):
+        if request_at is not None and i == request_at:
+            preemption.request(signal.SIGTERM)
+        return np.zeros((1, K), np.float32)
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(coordination, "get_coordinator", lambda: coord)
+        with pytest.raises(Preempted) as ei:
+            runner.run(dispatch, sync_ref=lambda: (),
+                       state_fn=lambda: {"x": np.float32(1)})
+    return tr, ei.value
+
+
+def test_peer_preemption_is_adopted_at_the_boundary(tmp_path):
+    """Only a PEER saw the SIGTERM (the vote returns True while the
+    local flag is clear): this host still drains, saves the agreed
+    step, barriers, and exits Preempted — the coordinated pod exit."""
+    # scripted verdicts: boundary-0 sig vote True (the peer's flag);
+    # the subsequent halt vote echoes the local False
+    coord = _ScriptedCoordinator([True])
+    tr, p = _run_plan(tmp_path, coord)
+    assert p.code == 128 + signal.SIGTERM  # adopted signum
+    assert p.saved_step == 0               # first boundary: unit 0
+    assert tr._ck.all_steps() == [0]
+    assert ("agree_min", 0) in coord.calls
+    # the pre-exit barrier came AFTER the vote and the agreement
+    assert coord.calls[-1][0] == "barrier"
+
+
+def test_local_preemption_votes_and_saves_agreed_step(tmp_path):
+    """The locally-signalled host goes through the same choreography:
+    vote -> agree_min(units_done) -> boundary save -> barrier ->
+    Preempted, with the save step the cluster minimum."""
+    coord = _ScriptedCoordinator([])  # echo local verdicts
+    tr, p = _run_plan(tmp_path, coord, request_at=0)
+    # signal during chunk 0 -> noticed at the NEXT boundary (units=2)
+    assert p.saved_step == 2
+    assert tr._ck.all_steps() == [2]
+    votes = [c for c in coord.calls if c[0] == "any_flag"]
+    # boundary-0 sig vote, boundary-0 halt vote, boundary-1 sig vote
+    assert votes[0] == ("any_flag", False)
+    assert ("any_flag", True) in votes[1:]  # the sig vote that carried
+    assert ("agree_min", 2) in coord.calls
+    assert coord.calls[-1][0] == "barrier"
+
+
+def test_uncoordinated_single_process_path_unchanged(tmp_path):
+    """world=1 (the real LocalCoordinator): same per-process semantics
+    as round 6 — boundary save + Preempted, no consensus cost beyond
+    the fault-point lookups."""
+    tr, p = _run_plan(tmp_path, LocalCoordinator(), request_at=1)
+    assert p.code == 143
+    assert p.saved_step == 4
+    assert tr._ck.all_steps() == [4]
+
+
+def test_coord_flag_fault_aborts_the_boundary_vote(tmp_path):
+    """An armed coord.flag makes the boundary vote itself the failure —
+    typed, at an exact call count, instead of a wedged pod."""
+    from dist_keras_tpu.trainers.chunking import ChunkRunner
+
+    tr = _FakeTrainer(str(tmp_path / "ck"))
+    runner = ChunkRunner(tr, plan=[2, 2], start=0, total=4, per_epoch=2,
+                        samples_per_unit=1, cadence=None)
+    faults.inject("coord.flag", at=1)  # second boundary's vote dies
+    with pytest.raises(faults.FaultInjected):
+        runner.run(lambda i, K, u, d: np.zeros((1, K), np.float32),
+                   sync_ref=lambda: (),
+                   state_fn=lambda: {"x": np.float32(1)})
+
+
+class _ScriptedCoordinator(coordination.Coordinator):
+    """world=2 stand-in with pre-scripted any_flag verdicts (popped per
+    call; falls back to the local flag when exhausted)."""
+
+    def __init__(self, responses):
+        self.world = 2
+        self.rank = 0
+        self.responses = list(responses)
+        self.calls = []
+
+    def any_flag(self, flag, timeout_s=None):
+        self.calls.append(("any_flag", bool(flag)))
+        if self.responses:
+            return bool(self.responses.pop(0))
+        return bool(flag)
+
+    def agree_min(self, value, timeout_s=None):
+        self.calls.append(("agree_min", value))
+        return value
+
+    def barrier(self, tag="dk_coord_barrier", timeout_s=None):
+        self.calls.append(("barrier", tag))
+        return self.world
+
+
+def test_peer_halt_verdict_halts_this_host_too(tmp_path):
+    """The NaN halt verdict is CLUSTER-wide: a peer that halted (vote
+    True at the boundary) halts this host as well, and neither persists
+    a checkpoint — an uncoordinated break would strand the peer's next
+    vote until the deadline."""
+    from dist_keras_tpu.trainers.chunking import ChunkRunner
+
+    tr = _FakeTrainer(str(tmp_path / "ck"))
+    tr.nan_policy = "halt"
+    # call order: top sig-vote (False), boundary halt-vote (True=peer)
+    coord = _ScriptedCoordinator([False, True])
+    runner = ChunkRunner(tr, plan=[2, 2, 2], start=0, total=6,
+                        per_epoch=2, samples_per_unit=1, cadence=None)
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(coordination, "get_coordinator", lambda: coord)
+        losses = runner.run(
+            lambda i, K, u, d: np.zeros((1, K), np.float32),
+            sync_ref=lambda: (), state_fn=lambda: {"x": np.float32(1)})
+    assert len(losses) == 1          # halted at the first boundary
+    assert tr._ck.all_steps() == []  # nobody persisted diverged state
+    assert ("any_flag", False) in coord.calls  # the boundary vote ran
+
+
+def test_local_halt_is_voted_at_a_natural_boundary(tmp_path):
+    """A NaN only THIS host saw: under multi-host coordination the halt
+    waits for the next natural boundary (identical loop position on
+    every host) and goes to a vote there — the vote carries True."""
+    from dist_keras_tpu.trainers.chunking import ChunkRunner
+
+    tr = _FakeTrainer(str(tmp_path / "ck"))
+    tr.nan_policy = "halt"
+    coord = _ScriptedCoordinator([])  # echo local verdicts
+    runner = ChunkRunner(tr, plan=[2, 2, 2], start=0, total=6,
+                        per_epoch=4, samples_per_unit=1, cadence=None)
+
+    def dispatch(i, K, units_done, data):
+        v = np.nan if i == 0 else 0.0  # poison chunk 0's losses
+        return np.full((1, K), v, np.float32)
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(coordination, "get_coordinator", lambda: coord)
+        losses = runner.run(dispatch, sync_ref=lambda: (),
+                            state_fn=lambda: {"x": np.float32(1)})
+    # natural boundary is at units=4 (chunk 1), where the retire trips
+    # the sentinel and the vote broadcasts True
+    assert len(losses) == 2
+    assert coord.calls[-1] == ("any_flag", True)
+    assert tr._ck.all_steps() == []
+    assert tr.nonfinite_steps > 0
+
+
+def test_two_phase_opt_out_keeps_per_host_independent_saves(
+        tmp_path, monkeypatch):
+    """DK_CKPT_TWO_PHASE=0: a pod whose checkpoint_dir is per-host
+    LOCAL scratch keeps the round-6 independent atomic save (markers
+    can't rendezvous across different machines' disks) — including its
+    own GC and retention."""
+    monkeypatch.setenv("DK_CKPT_TWO_PHASE", "0")
+    ck1 = _ckptr(tmp_path, rank=1, world=2)
+    ck1.save(5, _state(1, 5))
+    assert ck1.all_steps() == [5]  # committed alone, no marker wait
+    names = os.listdir(os.path.join(ck1.directory, "step_00000005"))
+    assert not any(n.startswith("host") for n in names)  # old layout
+    step, got = ck1.restore(template=_state(1, 5))
+    assert step == 5
+    orphan = os.path.join(ck1.directory, "step_00000001.tmp")
+    os.makedirs(orphan)
+    ck1.save(6, _state(1, 6))      # non-leader still sweeps ITS dir
+    assert not os.path.exists(orphan)
+
+
+def test_session_root_expands_home(monkeypatch):
+    monkeypatch.delenv("DK_COORD_SESSION", raising=False)
+    assert coordination._session_root("~/x") == os.path.expanduser("~/x")
+
+
+def test_file_coordinator_requires_explicit_rank(tmp_path, monkeypatch):
+    """DK_COORD_DIR without DK_COORD_RANK must be an actionable error,
+    not a KeyError (and never a silent rank-0 default — two self-
+    declared leaders would corrupt the commit protocol)."""
+    monkeypatch.delenv("DK_COORD_RANK", raising=False)
+    with pytest.raises(ValueError, match="DK_COORD_RANK"):
+        FileCoordinator(str(tmp_path))
+
+
+def test_env_faults_reject_unparseable_at_suffix(monkeypatch):
+    # "@x2" (missing the at-count) must fail loudly, not arm a literal
+    # point named "checkpoint.save@x2" that never fires
+    monkeypatch.setenv("DK_FAULTS", "checkpoint.save@x2")
+    with pytest.raises(ValueError, match="malformed"):
+        faults.load_env(force=True)
+
+
+# ---------------------------------------------------------------------------
+# comm.backend.barrier deadline + launch wiring
+# ---------------------------------------------------------------------------
+def test_comm_barrier_single_process_keeps_returning_device_count():
+    import jax
+
+    from dist_keras_tpu.comm import backend as comm
+
+    assert comm.barrier() == jax.device_count()
+    assert comm.barrier(timeout_s=30) == jax.device_count()  # ignored
+
+
+def test_comm_barrier_timeout_raises_typed_error_then_poisons(
+        monkeypatch):
+    from jax.experimental import multihost_utils
+
+    from dist_keras_tpu.comm import backend as comm
+
+    release = threading.Event()
+    monkeypatch.setattr(comm, "is_multi_host", lambda: True)
+    monkeypatch.setattr(comm, "_barrier_poisoned", None)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda tag: release.wait(10))
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(BarrierTimeout):
+            comm.barrier("stuck", timeout_s=0.2)
+        assert time.monotonic() - t0 < 5.0
+        # the abandoned sync may still complete on the peers: further
+        # barriers — timed or NOT — must refuse, not silently desync
+        # the stream
+        with pytest.raises(RuntimeError, match="poisoned"):
+            comm.barrier("retry", timeout_s=0.2)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            comm.barrier("untimed-retry")
+    finally:
+        release.set()  # unpin the abandoned daemon thread
+
+
+def test_comm_barrier_names_dead_host_via_heartbeats(
+        tmp_path, monkeypatch):
+    import jax
+
+    from jax.experimental import multihost_utils
+
+    from dist_keras_tpu.comm import backend as comm
+
+    release = threading.Event()
+    monkeypatch.setattr(comm, "is_multi_host", lambda: True)
+    monkeypatch.setattr(comm, "_barrier_poisoned", None)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda tag: release.wait(10))
+    monkeypatch.setenv("DK_COORD_DIR", str(tmp_path))
+    # host 1 BEAT once and went dark — heartbeat evidence, so the
+    # verdict upgrades to PeerLost naming it (a never-started host
+    # would stay a plain BarrierTimeout)
+    Heartbeat(str(tmp_path), rank=0).beat_once()
+    Heartbeat(str(tmp_path), rank=1).beat_once()
+    old = time.time() - 300
+    os.utime(os.path.join(str(tmp_path), "hb", "rank_1"), (old, old))
+    try:
+        with pytest.raises(PeerLost) as ei:
+            comm.barrier("stuck", timeout_s=0.2)
+        assert ei.value.ranks == (1,)
+    finally:
+        release.set()
+
+
+def test_job_exports_coordination_env_and_names_dead_hosts(tmp_path):
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jobdir"
+    jd.mkdir()
+    job = Job("s", "j1", str(jd), hosts=["h0", "h1"], dry_run=True,
+              coord_dir=str(tmp_path / "coord"))
+    env = job.host_env(1)
+    assert env["DK_COORD_DIR"] == str(tmp_path / "coord")
+    assert env["DK_COORD_RANK"] == "1"
+    assert env["DK_COORD_WORLD"] == "2"
+    # host 0's training process heartbeats; host 1 never does
+    Heartbeat(str(tmp_path / "coord"), rank=0).beat_once()
+    assert job.dead_hosts(stale_after_s=60) == [(1, "h1")]
+    # without a coord_dir there is nothing to inspect — explicit error
+    plain = Job("s", "j2", str(jd), hosts=["h0"], dry_run=True)
+    with pytest.raises(ValueError, match="coord_dir"):
+        plain.dead_hosts()
+
+
+def test_job_config_accepts_coord_dir(tmp_path):
+    from dist_keras_tpu.launch.config import JobConfig
+
+    jd = tmp_path / "jd"
+    jd.mkdir()
+    cfg = JobConfig.from_dict({
+        "job_name": "a", "job_dir": str(jd), "hosts": ["h1"],
+        "coord_dir": "/shared/coord"})
+    job = cfg.to_job(dry_run=True)
+    assert job.coord_dir == "/shared/coord"
+    assert "DK_COORD_DIR" in job.host_env(0)
+
+
+# ---------------------------------------------------------------------------
+# preemption.install main-thread guard
+# ---------------------------------------------------------------------------
+def _in_thread(fn):
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["error"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(10)
+    return box
+
+
+def test_install_off_main_thread_raises_clear_error():
+    box = _in_thread(lambda: preemption.install())
+    assert isinstance(box.get("error"), RuntimeError)
+    assert "main thread" in str(box["error"]).lower()
+    assert "strict=False" in str(box["error"])
+
+
+def test_install_off_main_thread_nonstrict_degrades_to_false():
+    box = _in_thread(lambda: preemption.install(strict=False))
+    assert box.get("value") is False
+    # and the trainer loop (which passes strict=False) still trains
+    # without a graceful window — no handlers were touched
+    assert signal.getsignal(signal.SIGTERM) != preemption._handler
+
+
+def test_install_on_main_thread_still_works():
+    try:
+        assert preemption.install() is True
+        assert signal.getsignal(signal.SIGTERM) is preemption._handler
+    finally:
+        preemption.restore()
+
+
+# ---------------------------------------------------------------------------
+# DK_FAULTS can arm the coordination exceptions by name
+# ---------------------------------------------------------------------------
+def test_env_faults_accept_coordination_exception_types(monkeypatch):
+    monkeypatch.setenv("DK_FAULTS",
+                       "x.peer@0:exc=PeerLost;y.bar@0:exc=BarrierTimeout")
+    faults.load_env(force=True)
+    with pytest.raises(PeerLost):
+        faults.fault_point("x.peer")
+    with pytest.raises(BarrierTimeout):
+        faults.fault_point("y.bar")
+
+
+# ---------------------------------------------------------------------------
+# the real thing: two processes, one SIGTERM, one agreed checkpoint
+# ---------------------------------------------------------------------------
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank, mode = int(sys.argv[1]), sys.argv[2]   # mode: preempt | resume
+os.environ["DK_COORD_DIR"] = %COORD%
+os.environ["DK_COORD_RANK"] = str(rank)
+os.environ["DK_COORD_WORLD"] = "2"
+os.environ["DK_COORD_SESSION"] = mode  # fresh op log per incarnation
+os.environ["DK_COORD_TIMEOUT_S"] = "120"
+
+import signal
+import numpy as np
+sys.path.insert(0, %REPO%)
+import dist_keras_tpu as dk
+from sklearn.datasets import load_digits
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import Dense, Sequential
+from dist_keras_tpu.utils.misc import one_hot
+
+digits = load_digits()
+x = (digits.data / 16.0).astype(np.float32)[:256]
+y = digits.target[:256]
+ds = Dataset({"features": x, "label": y, "label_encoded": one_hot(y, 10)})
+m = Sequential([Dense(16, activation="relu"), Dense(10)])
+m.build((64,), seed=0)
+
+def kill_cb(trainer, epoch, logs):
+    # the scheduler's SIGTERM reaches ONE host only, mid-run
+    if mode == "preempt" and rank == 0 and epoch == 2:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+t = dk.SingleTrainer(
+    m, loss="categorical_crossentropy", worker_optimizer="adam",
+    batch_size=16, label_col="label_encoded", seed=3, num_epoch=4,
+    checkpoint_dir=%CKPT%, checkpoint_every=2, max_checkpoints=10,
+    handle_preemption=True, resume=(mode == "resume"),
+    callbacks=[kill_cb])
+model = t.train(ds)
+ws = model.get_weights()
+np.savez(%OUT% + f"_{mode}_{rank}.npz", *ws)
+print("DONE", mode, rank, flush=True)
+"""
+
+
+@pytest.mark.slow  # two jax processes; the tier-1 budget excludes it
+def test_two_process_coordinated_preemption_and_bit_equal_resume(
+        tmp_path):
+    """The acceptance criterion end-to-end: two FileCoordinator
+    processes, a SIGTERM delivered to ONE of them mid-chunk -> both
+    checkpoint the SAME agreed step, both exit Preempted (128+SIGTERM),
+    and resume from that checkpoint is bit-equal to an uninterrupted
+    run on both ranks."""
+    coord = str(tmp_path / "coord")
+    ckpt = str(tmp_path / "ck")
+    out = str(tmp_path / "w")
+    script = (_WORKER
+              .replace("%COORD%", repr(coord))
+              .replace("%REPO%", repr(REPO))
+              .replace("%CKPT%", repr(ckpt))
+              .replace("%OUT%", repr(out)))
+    path = tmp_path / "worker.py"
+    path.write_text(script)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH",
+                        "DK_COORD_DIR", "DK_COORD_RANK", "DK_COORD_WORLD",
+                        "DK_COORD_SESSION", "DK_COORD_TIMEOUT_S",
+                        "DK_FAULTS")}
+    env["PYTHONPATH"] = REPO
+
+    def run_pair(mode):
+        procs = [subprocess.Popen(
+            [sys.executable, str(path), str(r), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True) for r in (0, 1)]
+        outs = [p.communicate(timeout=540)[0] for p in procs]
+        return [(p.returncode, o) for p, o in zip(procs, outs)]
+
+    # --- the preempted incarnation ---
+    results = run_pair("preempt")
+    for rank, (rc, o) in enumerate(results):
+        assert rc == 128 + signal.SIGTERM, \
+            f"rank {rank} rc={rc}:\n{o[-3000:]}"
+
+    from dist_keras_tpu.checkpoint import Checkpointer
+
+    spb = 256 // 16
+    saved = Checkpointer(ckpt, rank=0, world=2).all_steps()
+    assert saved == [2 * spb]  # ONE agreed, fully-committed step
+
+    # --- restart: both ranks resume and finish ---
+    results = run_pair("resume")
+    for rank, (rc, o) in enumerate(results):
+        assert rc == 0, f"rank {rank} rc={rc}:\n{o[-3000:]}"
+
+    # --- bit-equal to an uninterrupted run ---
+    control = _control_weights()
+    for rank in (0, 1):
+        got = np.load(out + f"_resume_{rank}.npz")
+        for k, w in zip(got.files, control):
+            np.testing.assert_array_equal(got[k], w)
+
+
+def _control_weights():
+    import dist_keras_tpu as dk
+
+    from sklearn.datasets import load_digits
+
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import Dense, Sequential
+    from dist_keras_tpu.utils.misc import one_hot
+
+    digits = load_digits()
+    x = (digits.data / 16.0).astype(np.float32)[:256]
+    y = digits.target[:256]
+    ds = Dataset({"features": x, "label": y,
+                  "label_encoded": one_hot(y, 10)})
+    m = Sequential([Dense(16, activation="relu"), Dense(10)])
+    m.build((64,), seed=0)
+    t = dk.SingleTrainer(
+        m, loss="categorical_crossentropy", worker_optimizer="adam",
+        batch_size=16, label_col="label_encoded", seed=3, num_epoch=4)
+    return t.train(ds).get_weights()
