@@ -92,6 +92,24 @@ BASELINES = {  # ideal 8-executor Spark/CPU samples/sec (see header)
 _RUNS_CAP = None
 
 
+def _obs_emit(kind, **fields):
+    """Bench-phase telemetry (observability subsystem), gated on the
+    env BEFORE any import: with DK_OBS_DIR unset nothing is imported —
+    the bench must stay able to emit its record without touching
+    jax-adjacent modules while the backend is wedged.  With it set, a
+    "backend unresponsive" run leaves a timeline showing the probe
+    begin with no probe end: exactly the attribution BENCH_r05.json
+    lacked."""
+    if not os.environ.get("DK_OBS_DIR"):
+        return
+    try:
+        from dist_keras_tpu.observability import events
+
+        events.emit(kind, **fields)
+    except Exception:  # never let telemetry kill the record
+        pass
+
+
 def _cap_runs(runs):
     return min(runs, _RUNS_CAP) if _RUNS_CAP else runs
 
@@ -714,7 +732,11 @@ def main():
     # multi-hour outage) — the pre-emitted line is then the record
     _emit()
     _honor_platform_env()
+    _obs_emit("bench_probe_begin", budget_s=budget)
+    t_probe = time.time()
     ok, detail = _backend_responsive()
+    _obs_emit("bench_probe_end", ok=ok, detail=detail,
+              duration_s=round(time.time() - t_probe, 3))
     if not ok:
         # partial stays TRUE: no config ran, so the record must not
         # read as a completed measurement — the reason field says why
@@ -743,6 +765,8 @@ def main():
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,
                                     "skipped": "budget"})
+            _obs_emit("bench_config_skipped", name=fn.__name__,
+                      elapsed_s=round(elapsed, 1))
             print(f"[bench] {fn.__name__}: skipped "
                   f"(elapsed {elapsed:.0f}s > budget {budget:.0f}s)",
                   file=sys.stderr, flush=True)
@@ -753,11 +777,15 @@ def main():
                   "downshifting to median-of-3", file=sys.stderr,
                   flush=True)
         t0 = time.time()
+        _obs_emit("bench_config_begin", name=fn.__name__)
         try:
             row = fn(peak)
         except Exception as e:  # a failing config must not kill the line
             row = {"name": fn.__name__, "error": repr(e)[:200]}
         row["duration_s"] = round(time.time() - t0, 1)
+        _obs_emit("bench_config_end", name=fn.__name__,
+                  duration_s=row["duration_s"],
+                  error=row.get("error"))
         _OUT["configs"].append(row)
         if row.get("name") == "adag_mnist_cnn" and "error" not in row:
             _OUT["value"] = row["samples_per_sec_per_chip"]
@@ -767,6 +795,9 @@ def main():
               f"-> {row}", file=sys.stderr, flush=True)
 
     _COMPLETED = True
+    _obs_emit("bench_complete",
+              n_configs=len(_OUT["configs"]),
+              elapsed_s=round(time.time() - t_start, 1))
     _emit(last=True)
 
 

@@ -158,7 +158,8 @@ class Checkpointer:
             from dist_keras_tpu.resilience.retry import RetryPolicy
 
             retry = RetryPolicy(attempts=3, backoff=0.05, jitter=0.0,
-                                retryable=(OSError,))
+                                retryable=(OSError,),
+                                name="checkpoint.save")
         self._retry = retry
         self._inflight = None  # "step_NNNNNNNN" currently being written
         self._ckpt = ocp.StandardCheckpointer() if _HAVE_ORBAX else None
@@ -301,20 +302,32 @@ class Checkpointer:
         committed ``step_N`` only when ALL markers have landed (deadline
         -> typed ``PeerLost``, never a hang).
         """
+        import time as _time
+
+        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.observability.spans import span
+
+        t0 = _time.perf_counter()
         state = _to_host(state)
         rank, world = self._coord_ids()
         if world > 1 and _two_phase_enabled():
-            self._save_multihost(step, state, rank, world)
+            with span("ckpt.save", step=step):
+                self._save_multihost(step, state, rank, world)
+            events.emit("ckpt_save", step=step, world=world,
+                        duration_s=_time.perf_counter() - t0)
             return
         final = self._step_dir(step)
         tmp = final + ".tmp"
         self._inflight = os.path.basename(final)
         try:
-            self._retry.call(self._save_once, tmp, final, state)
+            with span("ckpt.save", step=step):
+                self._retry.call(self._save_once, tmp, final, state)
             self._gc_orphans()
         finally:
             self._inflight = None
         self._retain()
+        events.emit("ckpt_save", step=step, world=world,
+                    duration_s=_time.perf_counter() - t0)
 
     def _write_payload(self, tmp, state):
         """Write ``state`` into the staging dir ``tmp`` (clean-slate) and
@@ -418,13 +431,13 @@ class Checkpointer:
         cluster's single commit instant: a kill anywhere before it
         leaves the step invisible to every reader."""
         from dist_keras_tpu.resilience.coordination import (
-            DEFAULT_TIMEOUT_S,
+            default_timeout_s,
             get_coordinator,
             wait_for_peers,
         )
         from dist_keras_tpu.resilience.faults import fault_point
 
-        timeout_s = (DEFAULT_TIMEOUT_S if self.commit_timeout_s is None
+        timeout_s = (default_timeout_s() if self.commit_timeout_s is None
                      else self.commit_timeout_s)
 
         def _probe(kind):
@@ -457,6 +470,11 @@ class Checkpointer:
         # nothing promoted) is deterministically injectable here
         fault_point("coord.commit")
         self._swap_in(stage, final)
+        from dist_keras_tpu.observability import events
+
+        m = _STEP_RE.match(os.path.basename(final))
+        events.emit("ckpt_promote", world=world,
+                    step=int(m.group(1)) if m else None)
 
     def _save_multihost(self, step, state, rank, world):
         """Two-phase commit across ``world`` hosts sharing this
@@ -488,6 +506,16 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        step, state = self._restore_inner(step, template)
+        # emitted AFTER the load: like ckpt_save, only a COMPLETED
+        # restore is recorded — a crash-loop whose every restart fails
+        # to restore must not read as N successful restores
+        from dist_keras_tpu.observability import events
+
+        events.emit("ckpt_restore", step=int(step))
+        return step, state
+
+    def _restore_inner(self, step, template):
         path = self._payload_dir(self._read_path(step))
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):  # fallback-format checkpoint

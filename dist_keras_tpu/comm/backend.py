@@ -105,6 +105,18 @@ def local_data_slice(n_rows, process=None, count=None):
     return start, stop
 
 
+def barrier_default_timeout_s():
+    """The multi-host barrier deadline used when a caller passes
+    ``timeout_s=None``: ``coordination.default_timeout_s()`` — the ONE
+    ``DK_COORD_TIMEOUT_S`` knob (``launch.Job(coord_timeout_s=...)`` /
+    ``JobConfig.coord_timeout_s`` export it per host), default 120 s,
+    re-read per call so a launcher-exported env wins over import
+    order.  Returns 0.0 (no deadline) when the env opts out with 0."""
+    from dist_keras_tpu.resilience.coordination import default_timeout_s
+
+    return default_timeout_s()
+
+
 def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
     """Block until every PROCESS reaches this point.
 
@@ -119,12 +131,21 @@ def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
     to hang every survivor here forever; now the wait gives up with a
     typed ``resilience.coordination.PeerLost`` (when heartbeat liveness
     files under ``DK_COORD_DIR`` name the dark rank) or
-    ``BarrierTimeout``.  The single-process path has nobody to wait for
-    and keeps returning the device count immediately.
+    ``BarrierTimeout``.  Since the observability PR ``timeout_s=None``
+    no longer means "wait forever": the default comes from
+    :func:`barrier_default_timeout_s` (``DK_COORD_TIMEOUT_S``, wired
+    through ``JobConfig.coord_timeout_s``), so an UNparameterized pod
+    barrier still cannot hang indefinitely.  Pass ``timeout_s=0`` to
+    explicitly opt out of the deadline.  The single-process path has
+    nobody to wait for and keeps returning the device count
+    immediately.
     """
     devs = jax.devices()
     if is_multi_host():
         from jax.experimental import multihost_utils
+
+        if timeout_s is None:
+            timeout_s = barrier_default_timeout_s()
 
         global _barrier_poisoned
         if _barrier_poisoned:
@@ -139,6 +160,11 @@ def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
                 "unknowable — restart the process instead of "
                 "retrying barriers")
 
+        import time as _time
+
+        from dist_keras_tpu.observability import events
+
+        t0 = _time.perf_counter()
         if timeout_s:
             from dist_keras_tpu.resilience import coordination
 
@@ -158,9 +184,15 @@ def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
             except (coordination.PeerLost,
                     coordination.BarrierTimeout) as e:
                 _barrier_poisoned = str(e)
+                events.emit("barrier", tag=tag,
+                            duration_s=_time.perf_counter() - t0,
+                            error=type(e).__name__)
                 raise
         else:
             multihost_utils.sync_global_devices(tag)
+        events.emit("barrier", tag=tag,
+                    duration_s=_time.perf_counter() - t0,
+                    n_devices=len(devs))
         return len(devs)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

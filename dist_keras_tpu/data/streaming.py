@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from dist_keras_tpu.data.predictors import Predictor
+from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.retry import RetryPolicy
 from dist_keras_tpu.utils.serialization import deserialize_model
@@ -246,7 +247,13 @@ class StreamingPredictor(Predictor):
         # OSError/ConnectionError from the socket layer) are retried; a
         # clean end-of-stream or a RuntimeError stream failure is final
         self.fetch_retry = fetch_retry or RetryPolicy(
-            attempts=3, backoff=0.02, jitter=0.0, retryable=(OSError,))
+            attempts=3, backoff=0.02, jitter=0.0, retryable=(OSError,),
+            name="stream.fetch")
+        # per-micro-batch accounting (not per row) riding the registry
+        # snapshots; resolved ONCE — the yield loop must not pay the
+        # registry lock per tick
+        self._m_batches = _metrics.counter("stream.batches")
+        self._m_rows = _metrics.counter("stream.rows")
         model = deserialize_model(self.serialized)
         params = model.params
         apply_fn = model.apply
@@ -287,6 +294,8 @@ class StreamingPredictor(Predictor):
                     x = np.concatenate(
                         [x, np.repeat(x[-1:], pad, axis=0)])
                 preds = np.asarray(self._predict(jnp.asarray(x)))[:n]
+                self._m_batches.inc()
+                self._m_rows.inc(n)
                 yield x[:n], preds
             elif not pending and source.closed:
                 return

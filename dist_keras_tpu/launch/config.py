@@ -44,10 +44,21 @@ class JobConfig:
     retries: int = 2
     retry_backoff: float = 0.5
     launch_retries: int = 0
-    # shared liveness/consensus directory (this PR): when set, every
-    # host heartbeats + coordinates preemption through it, and the
+    # shared liveness/consensus directory: when set, every host
+    # heartbeats + coordinates preemption through it, and the
     # launcher's Job.dead_hosts() can name a dead host
     coord_dir: str | None = None
+    # cluster collective deadline (seconds), exported per host as
+    # DK_COORD_TIMEOUT_S: coordination.default_timeout_s() AND the
+    # comm.barrier(timeout_s=None) default both read it, so this one
+    # declarative knob closes the ROADMAP follow-up of wiring barrier
+    # timeouts through launch configs.  None keeps the workers' own
+    # default (120 s); 0 opts out of deadlines entirely.
+    coord_timeout_s: float | None = None
+    # per-host event-log directory (observability subsystem), exported
+    # as DK_OBS_DIR; Job.collect_obs(dest) rsyncs the logs back and
+    # `python -m dist_keras_tpu.observability` merges the timeline
+    obs_dir: str | None = None
 
     # operator-facing JSON surface: validate types, not just names — a
     # string where a list belongs (hosts: "localhost") would otherwise
@@ -58,7 +69,9 @@ class JobConfig:
               "remote_root": str, "python": str,
               "retries": int, "retry_backoff": (int, float),
               "launch_retries": int,
-              "coord_dir": (str, type(None))}
+              "coord_dir": (str, type(None)),
+              "coord_timeout_s": (int, float, type(None)),
+              "obs_dir": (str, type(None))}
 
     @classmethod
     def from_dict(cls, d):

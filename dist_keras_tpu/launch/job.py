@@ -74,7 +74,7 @@ class Job:
                  hosts=(), coordinator_port=8476, num_processes=None,
                  remote_root="~/jobs", python="python3", dry_run=False,
                  retries=2, retry_backoff=0.5, launch_retries=0,
-                 coord_dir=None):
+                 coord_dir=None, coord_timeout_s=None, obs_dir=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -129,6 +129,24 @@ class Job:
             raise ValueError(
                 f"coord_dir {coord_dir!r} must match [A-Za-z0-9._/~-]+")
         self.coord_dir = coord_dir
+        # coord_timeout_s: the cluster-wide collective deadline exported
+        # as DK_COORD_TIMEOUT_S — coordination.default_timeout_s() and
+        # comm.barrier's default both read it, so one launch-config knob
+        # governs every "how long before a dead peer is a typed error"
+        # decision on every host
+        self.coord_timeout_s = (None if coord_timeout_s is None
+                                else float(coord_timeout_s))
+        # obs_dir: per-host event-log directory (DK_OBS_DIR) — the run
+        # telemetry plane (observability subsystem).  Usually a path on
+        # each host's local disk; collect_obs() rsyncs every host's
+        # directory back so `python -m dist_keras_tpu.observability`
+        # can merge the timeline launcher-side.  A shared-fs path works
+        # too (the event files are per-rank, so hosts never contend).
+        if obs_dir is not None \
+                and not re.match(r"^[A-Za-z0-9._/~-]+$", str(obs_dir)):
+            raise ValueError(
+                f"obs_dir {obs_dir!r} must match [A-Za-z0-9._/~-]+")
+        self.obs_dir = obs_dir
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
@@ -188,6 +206,13 @@ class Job:
             env["DK_COORD_DIR"] = str(self.coord_dir)
             env["DK_COORD_RANK"] = str(pid)
             env["DK_COORD_WORLD"] = str(self.num_processes)
+        if self.coord_timeout_s is not None:
+            env["DK_COORD_TIMEOUT_S"] = str(self.coord_timeout_s)
+        if self.obs_dir:
+            # telemetry plane (observability): each host's event log
+            # lands in <obs_dir>/events-rank_{pid}.jsonl (the writer
+            # reads its rank from DK_COORD_RANK / JAX_PROCESS_ID)
+            env["DK_OBS_DIR"] = str(self.obs_dir)
         return env
 
     def dead_hosts(self, stale_after_s=None):
@@ -211,6 +236,27 @@ class Job:
             stale_after_s=stale_after_s)
         return [(r, self.hosts[r] if r < len(self.hosts) else None)
                 for r in dead]
+
+    def collect_obs(self, dest):
+        """rsync every host's ``obs_dir`` event log back to
+        ``dest/host_{i}/`` on the launcher (each host's command retried
+        with backoff, same as :meth:`sync`) — then
+        ``python -m dist_keras_tpu.observability dest/host_{i}`` (or a
+        merge of the collected files) reconstructs the run timeline.
+        Per-rank file names never collide, so merging all ``host_*``
+        subdirectories into one directory is also safe."""
+        if not self.obs_dir:
+            raise ValueError("Job has no obs_dir: nothing to collect")
+        dest = os.path.abspath(dest)
+        rc = 0
+        for pid, host in enumerate(self.hosts):
+            hostdir = os.path.join(dest, f"host_{pid}")
+            if not self.dry_run:
+                os.makedirs(hostdir, exist_ok=True)
+            rc |= self._run_retried([
+                "rsync", "-az", f"{host}:{self.obs_dir}/",
+                hostdir + "/"], point="job.rsync")
+        return rc
 
     def launch(self):
         """Start the entrypoint on every host under jax.distributed env."""

@@ -1,0 +1,53 @@
+"""Run telemetry: structured events, metrics registry, span tracing,
+multi-host run reports.
+
+The reference's only instrumentation is trainer wall-clock timing
+(``record_training_start/stop``); this subsystem is the §5 "tracing" row
+grown to production shape, recording what a run was *doing* — so a hang,
+a ``BarrierTimeout`` or an unresponsive backend leaves a timeline naming
+the host and phase that stalled instead of silence:
+
+- :mod:`~dist_keras_tpu.observability.events` — append-only per-host
+  JSONL under ``DK_OBS_DIR`` (atomic line writer; zero-cost no-op when
+  the env is unset; never throws into training code).  Every seam emits
+  typed events: epoch ends, chunk boundaries, checkpoint
+  save/promote/restore, retry attempts, fault-point fires, preemption
+  signals, coordination votes/barriers with durations, dead-peer
+  transitions, NaN-sentinel hits.
+- :mod:`~dist_keras_tpu.observability.metrics` — process-wide named
+  counters/gauges/histograms (the grown-up ``StepTimer``, which is now a
+  thin wrapper); snapshots ride the event stream at epoch boundaries.
+- :mod:`~dist_keras_tpu.observability.spans` — nested ``span(name)``
+  regions stamped into the event log and forwarded to
+  ``jax.profiler.TraceAnnotation`` while a device trace is active.
+- :mod:`~dist_keras_tpu.observability.report` — merge per-host logs
+  into one (time, rank)-ordered timeline with per-phase summaries;
+  also the CLI: ``python -m dist_keras_tpu.observability <dir>``.
+
+See the README "Observability" section for the env knobs
+(``DK_OBS_DIR`` / ``DK_OBS_FLUSH``), the event schema table and CLI
+examples.
+"""
+
+from dist_keras_tpu.observability import events, metrics, report, spans
+from dist_keras_tpu.observability.events import (
+    EventWriter,
+    emit,
+    enabled,
+    obs_dir,
+)
+from dist_keras_tpu.observability.metrics import (
+    counter,
+    emit_snapshot,
+    gauge,
+    histogram,
+    snapshot,
+)
+from dist_keras_tpu.observability.spans import span
+
+__all__ = [
+    "events", "metrics", "report", "spans",
+    "EventWriter", "emit", "enabled", "obs_dir",
+    "counter", "gauge", "histogram", "snapshot", "emit_snapshot",
+    "span",
+]

@@ -1,0 +1,54 @@
+"""CLI for the run report — ``python -m dist_keras_tpu.observability``.
+
+  # human-readable timeline summary + last-N events per host
+  python -m dist_keras_tpu.observability /path/to/obs_dir [--last 20]
+
+  # machine-readable: the merged summary (or the full merged timeline)
+  python -m dist_keras_tpu.observability /path/to/obs_dir --json
+  python -m dist_keras_tpu.observability /path/to/obs_dir --json --raw
+
+Point it at the directory a run exported as ``DK_OBS_DIR`` (for a pod
+job launched with ``Job(obs_dir=...)``, the launcher's
+``collect_obs(dest)`` rsyncs every host's directory back first).
+Exit code 1 when the directory holds no events — a monitoring loop can
+distinguish "nothing recorded" from an empty-but-healthy run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dist_keras_tpu.observability import report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m dist_keras_tpu.observability",
+        description="Merge per-host DK_OBS_DIR event logs into one "
+                    "timeline and summarize the run.")
+    ap.add_argument("obs_dir", help="directory holding "
+                                    "events-rank_*.jsonl files")
+    ap.add_argument("--last", type=int, default=10,
+                    help="events per host in the tail section "
+                         "(default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged summary as JSON")
+    ap.add_argument("--raw", action="store_true",
+                    help="with --json: print the full merged event "
+                         "timeline instead of the summary")
+    args = ap.parse_args(argv)
+
+    events = report.read_events(args.obs_dir)
+    if args.json:
+        doc = events if args.raw else report.summarize(events)
+        json.dump(doc, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(report.render(args.obs_dir, last_n=args.last))
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Structured event log — append-only, per-host JSONL under ``DK_OBS_DIR``.
+
+The paper's only instrumentation was trainer wall-clock timing; after the
+two resilience PRs this repo has retries, fault points, two-phase
+checkpoint commits, coordination votes, barriers and heartbeats all
+happening silently — and the r05 bench died with an unattributable
+"backend unresponsive" because nothing recorded what the run was doing
+when it stopped.  This module is the recording layer every seam emits
+into:
+
+- **One JSONL file per host** (``events-rank_{i}.jsonl``), so hosts never
+  contend on a shared file; ``report.py`` merges them post-hoc into a
+  single (time, rank)-ordered timeline.
+- **Atomic line writer**: each event is serialized to one line and
+  written with a single ``os.write`` on an ``O_APPEND`` fd — concurrent
+  writers (the heartbeat thread, deadline probe threads) never interleave
+  partial lines, and a crash mid-run loses at most the event being
+  written, never the file.
+- **Zero-cost when off**: with ``DK_OBS_DIR`` unset, :func:`emit` is one
+  cached boolean check — no file handles, no JSON encoding, no host
+  sync.  That is the tier-1 contract: instrumented seams cost nothing
+  unless an operator opts in.
+- **Never throws into training code**: any failure (disk full, bad
+  field, closed fd) degrades to a dropped event plus ONE warning per
+  process on stderr.  Observability must never be the thing that kills
+  the run it observes.
+
+Env knobs:
+
+- ``DK_OBS_DIR`` — directory for the per-host event files (created on
+  first emit).  Unset = disabled.
+- ``DK_OBS_FLUSH=1`` — fsync after every line (power-loss durable;
+  default is write-per-line, which already survives a process crash).
+
+Event schema: every record carries ``t`` (``time.time()``), ``seq`` (a
+per-process monotonic counter — the tiebreaker for same-timestamp
+ordering), ``rank`` (``DK_COORD_RANK`` > ``JAX_PROCESS_ID`` > 0, read at
+writer construction so no jax import is needed), ``kind``, and the
+emitting seam's keyword fields.  See the README "Observability" section
+for the kind-by-kind table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_lock = threading.Lock()
+_resolved = False      # has the DK_OBS_DIR decision been made?
+_writer = None         # EventWriter when enabled, None when disabled
+_warned = False        # one dropped-event warning per process
+
+
+def _default_rank():
+    """This host's rank WITHOUT importing jax (the event log must work
+    before — and while — the device backend is wedged): the coordination
+    identity wins, then the launcher's jax.distributed id, then 0."""
+    for var in ("DK_COORD_RANK", "JAX_PROCESS_ID"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+class EventWriter:
+    """Append-only JSONL writer for one host's event file.
+
+    Exposed as a class (rather than only the module-level singleton) so
+    tests and launcher-side tools can write a specific rank's file
+    explicitly; training code should use :func:`emit`.
+    """
+
+    def __init__(self, directory, rank=None, fsync=None):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        self.rank = _default_rank() if rank is None else int(rank)
+        if fsync is None:
+            fsync = os.environ.get("DK_OBS_FLUSH", "") \
+                in ("1", "true", "fsync")
+        self.fsync = bool(fsync)
+        self.path = os.path.join(self.directory,
+                                 f"events-rank_{self.rank}.jsonl")
+        os.makedirs(self.directory, exist_ok=True)
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def emit(self, kind, **fields):
+        """Write one event line.  Raises on failure — the module-level
+        :func:`emit` is the never-throws wrapper."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        record = {"t": time.time(), "seq": seq, "rank": self.rank,
+                  "kind": str(kind)}
+        record.update(fields)
+        # default=str: an event must not be droppable by an exotic field
+        # type (numpy scalar, Path, exception instance)
+        line = (json.dumps(record, default=str) + "\n").encode("utf-8")
+        os.write(self._fd, line)  # O_APPEND: one atomic line per event
+        if self.fsync:
+            os.fsync(self._fd)
+
+    def close(self):
+        try:
+            os.close(self._fd)
+        except OSError:  # pragma: no cover - double close
+            pass
+
+
+def _resolve():
+    global _resolved, _writer
+    with _lock:
+        if _resolved:
+            return
+        directory = os.environ.get("DK_OBS_DIR")
+        if directory:
+            try:
+                _writer = EventWriter(directory)
+            except Exception as e:
+                _warn_once(f"could not open event log in "
+                           f"{directory!r}: {e!r}")
+                _writer = None
+        _resolved = True
+
+
+def _warn_once(msg):
+    global _warned
+    if _warned:
+        return
+    _warned = True
+    print(f"[dk.observability] WARNING: {msg} — further events are "
+          "dropped silently", file=sys.stderr, flush=True)
+
+
+def enabled():
+    """True iff ``DK_OBS_DIR`` selected an event log (cached; call
+    :func:`reset` after changing the env)."""
+    if not _resolved:
+        _resolve()
+    return _writer is not None
+
+
+def obs_dir():
+    """The active event-log directory, or None when disabled."""
+    if not _resolved:
+        _resolve()
+    return _writer.directory if _writer is not None else None
+
+
+def rank():
+    """The active writer's rank (None when disabled) — lets seams make
+    leader-only decisions (e.g. who writes the merged report) without
+    re-deriving the identity env."""
+    if not _resolved:
+        _resolve()
+    return _writer.rank if _writer is not None else None
+
+
+def emit(kind, **fields):
+    """Emit one structured event — the seam-facing entry point.
+
+    No-op when ``DK_OBS_DIR`` is unset (one boolean check).  NEVER
+    raises: a failed write degrades to a dropped event plus one warning,
+    because this is called from checkpoint commits, signal-adjacent
+    paths and retry loops that must not die of their own telemetry.
+    """
+    if not _resolved:
+        _resolve()
+    w = _writer
+    if w is None:
+        return
+    try:
+        w.emit(kind, **fields)
+    except Exception as e:
+        _warn_once(f"event emit failed ({kind}): {e!r}")
+
+
+def reset():
+    """Close the writer and forget the cached ``DK_OBS_DIR`` decision —
+    tests that flip the env need a fresh resolution."""
+    global _resolved, _writer, _warned
+    with _lock:
+        if _writer is not None:
+            _writer.close()
+        _writer = None
+        _resolved = False
+        _warned = False

@@ -1,0 +1,200 @@
+"""Process-wide metrics registry — named counters, gauges, histograms.
+
+``utils/profiling.StepTimer`` sketched this in miniature (a list of
+per-call durations with summary stats); this module grows it into the
+registry every subsystem shares: trainers, ``comm.backend``,
+``checkpoint``, ``resilience.retry`` and ``data.streaming`` register
+named instruments here, and the whole registry snapshots to JSON at
+epoch boundaries into the event stream (``events.py``), so a post-hoc
+report can say "this run retried rsync 7 times and spent 12 s in
+checkpoint saves" without anyone having threaded those numbers through
+return values.
+
+Design points:
+
+- **Get-or-create by name** (:func:`counter` / :func:`gauge` /
+  :func:`histogram`): call sites never coordinate registration order,
+  and the same name from two modules is the same instrument.
+- **Cheap always-on**: incrementing a counter is a lock + int add —
+  safe on warm host-side paths (per-chunk, per-retry; NOT the compiled
+  per-step device loop, which cannot host Python hooks).  File I/O only
+  happens at explicit :func:`emit_snapshot` points, and only when
+  ``DK_OBS_DIR`` is set.
+- **Zero-length windows are guarded**: an empty histogram summarizes to
+  ``count: 0`` with ``None`` stats instead of a numpy warning or a
+  raise — the same convention ``StepTimer.summary`` now follows.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from dist_keras_tpu.observability import events
+
+
+class Counter:
+    """Monotonic named count (retry attempts, nonfinite steps, ...)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins named value (resident bytes, world size, ...)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Sample distribution with percentile summaries (durations).
+
+    ``count`` / ``mean`` / ``total`` / ``max`` are EXACT over the whole
+    lifetime (until :meth:`reset`); percentiles are computed over a
+    bounded window of the most recent :data:`Histogram.WINDOW` samples,
+    so a week-long run's memory stays flat and the epoch-boundary
+    snapshot cost stays O(window) instead of growing quadratically with
+    run length.  A recent window is also the operationally useful
+    percentile — "what do saves cost *now*", not diluted by hour-one.
+    """
+
+    WINDOW = 4096
+
+    def __init__(self, name=None):
+        import collections
+
+        self.name = name
+        self._window = collections.deque(maxlen=self.WINDOW)
+        self._count = 0
+        self._total = 0.0
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self._window.append(value)
+            self._count += 1
+            self._total += value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    def reset(self):
+        with self._lock:
+            self._window.clear()
+            self._count = 0
+            self._total = 0.0
+            self._max = None
+
+    @property
+    def samples(self):
+        """The retained (most recent) samples — the percentile window."""
+        with self._lock:
+            return list(self._window)
+
+    def summary(self):
+        """-> {count, mean, p50, p95, p99, max, total}; a zero-length
+        window returns ``count: 0`` with ``None`` stats (``total: 0.0``)
+        instead of raising from the percentile math."""
+        with self._lock:
+            count, total, mx = self._count, self._total, self._max
+            window = list(self._window)
+        if count == 0:
+            return {"count": 0, "mean": None, "p50": None, "p95": None,
+                    "p99": None, "max": None, "total": 0.0}
+        # one percentile pass for all three points (summary() runs at
+        # every epoch-boundary snapshot — it is warm-path-adjacent)
+        p50, p95, p99 = np.percentile(
+            np.asarray(window, dtype=np.float64), (50, 95, 99))
+        return {
+            "count": count,
+            "mean": total / count,
+            "p50": float(p50),
+            "p95": float(p95),
+            "p99": float(p99),
+            "max": mx,
+            "total": total,
+        }
+
+
+_lock = threading.Lock()
+_registry = {}  # name -> instrument
+
+
+def _get(name, cls):
+    with _lock:
+        inst = _registry.get(name)
+        if inst is None:
+            inst = _registry[name] = cls(name)
+        elif not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} is already registered as "
+                f"{type(inst).__name__}, not {cls.__name__}")
+        return inst
+
+
+def counter(name):
+    return _get(str(name), Counter)
+
+
+def gauge(name):
+    return _get(str(name), Gauge)
+
+
+def histogram(name):
+    return _get(str(name), Histogram)
+
+
+def snapshot():
+    """-> JSON-ready dict of every registered instrument's current
+    value: ``{"counters": {...}, "gauges": {...}, "histograms":
+    {name: summary}}``."""
+    with _lock:
+        items = list(_registry.items())
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for name, inst in items:
+        if isinstance(inst, Counter):
+            out["counters"][name] = inst.value
+        elif isinstance(inst, Gauge):
+            out["gauges"][name] = inst.value
+        else:
+            out["histograms"][name] = inst.summary()
+    return out
+
+
+def emit_snapshot(**extra):
+    """Write the registry snapshot into the event stream (one
+    ``"metrics"`` event) — the epoch-boundary hook trainers call.
+    No-op when ``DK_OBS_DIR`` is unset, and the snapshot itself is only
+    computed when the emit will land."""
+    if not events.enabled():
+        return
+    events.emit("metrics", **snapshot(), **extra)
+
+
+def reset():
+    """Drop every registered instrument (tests)."""
+    with _lock:
+        _registry.clear()

@@ -1,0 +1,272 @@
+"""Multi-host run report — merge per-host event logs into one timeline.
+
+The launcher (or anyone pointed at a ``DK_OBS_DIR`` after the fact —
+``python -m dist_keras_tpu.observability <dir>``) merges the per-host
+``events-rank_{i}.jsonl`` files into a single timeline ordered by
+``(time, rank, seq)`` and summarizes it: per-phase durations (from
+spans), coordination-op durations, retry counts, checkpoint commits,
+nonfinite-step totals, preemption attribution (WHICH rank got the
+signal, what step the cluster agreed to save), and the last-N events per
+host — which is exactly the artifact needed to attribute a hang like the
+r05 "backend unresponsive" bench failure or a ``BarrierTimeout`` to the
+host that stalled: the dead host's file simply *stops*, and the merged
+tail shows what every other host was waiting on.
+
+Strictly read-only and import-light (stdlib only): safe to run from a
+monitor loop against a live run's directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+_FILE_RE = re.compile(r"^events-rank_(\d+)\.jsonl$")
+
+
+def event_files(directory):
+    """-> sorted [(rank, path)] of the per-host event files."""
+    directory = os.path.abspath(os.path.expanduser(str(directory)))
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def read_events(directory):
+    """Merged timeline: every host's events ordered by (t, rank, seq).
+
+    A torn final line (host killed mid-write — the atomic line writer
+    makes this rare but a dying fs can still truncate) is skipped, not
+    fatal: the report must work best exactly when the run died worst.
+    """
+    events = []
+    for rank, path in event_files(directory):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn tail line
+            ev.setdefault("rank", rank)
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("t", 0.0), e.get("rank", 0),
+                               e.get("seq", 0)))
+    return events
+
+
+def _acc(table, key, dt):
+    row = table.setdefault(key, {"count": 0, "total_s": 0.0,
+                                 "max_s": 0.0})
+    row["count"] += 1
+    if dt is not None:
+        row["total_s"] += float(dt)
+        row["max_s"] = max(row["max_s"], float(dt))
+
+
+def summarize(events):
+    """-> structured summary of a merged timeline (JSON-ready)."""
+    ranks = {}
+    phases = {}       # span path -> {count, total_s, max_s}
+    coord = {}        # coordination/barrier op -> {count, total_s, max_s}
+    retries = {}      # retry-surface name -> {attempts, exhausted}
+    faults = {}       # fault point -> fires
+    saves = {}        # rank -> last ckpt_save step
+    promoted = []
+    restored = []
+    epochs = {}       # rank -> epoch_end count
+    signalled = {}    # rank -> signum (preemption attribution)
+    dead = []         # peer-dead transitions [(rank reporting, peer)]
+    nonfinite = 0
+    for ev in events:
+        rank = int(ev.get("rank", 0))
+        kind = ev.get("kind", "?")
+        row = ranks.setdefault(rank, {"events": 0, "first_t": None,
+                                      "last_t": None, "last_kind": None})
+        row["events"] += 1
+        t = ev.get("t")
+        if t is not None:
+            if row["first_t"] is None:
+                row["first_t"] = t
+            row["last_t"] = t
+        row["last_kind"] = kind
+        if kind == "span_end":
+            _acc(phases, ev.get("span", "?"), ev.get("duration_s"))
+        elif kind in ("coord", "coord_error"):
+            _acc(coord, ev.get("op", "?"), ev.get("duration_s"))
+        elif kind == "barrier":
+            _acc(coord, f"comm.barrier({ev.get('tag', '?')})",
+                 ev.get("duration_s"))
+        elif kind == "retry":
+            r = retries.setdefault(ev.get("name", "?"),
+                                   {"attempts": 0, "exhausted": 0})
+            r["attempts"] += 1
+        elif kind == "retry_exhausted":
+            r = retries.setdefault(ev.get("name", "?"),
+                                   {"attempts": 0, "exhausted": 0})
+            r["exhausted"] += 1
+        elif kind == "fault":
+            point = ev.get("point", "?")
+            faults[point] = faults.get(point, 0) + 1
+        elif kind == "ckpt_save":
+            if ev.get("step") is not None:
+                saves[rank] = int(ev["step"])
+        elif kind == "ckpt_promote":
+            if ev.get("step") is not None:
+                promoted.append(int(ev["step"]))
+        elif kind == "ckpt_restore":
+            if ev.get("step") is not None:
+                restored.append(int(ev["step"]))
+        elif kind == "epoch_end":
+            epochs[rank] = epochs.get(rank, 0) + 1
+            nonfinite += int(ev.get("nonfinite_steps", 0) or 0)
+        elif kind in ("preempt_signal", "preempt"):
+            # attribution, not participation: every host emits a
+            # "preempt" at the boundary where it honors the cluster
+            # vote, but a host that merely ADOPTED the verdict
+            # (adopted=True) did not receive the OS signal — only the
+            # genuinely-signalled rank(s) belong here
+            if not ev.get("adopted"):
+                signalled.setdefault(rank, ev.get("signum"))
+        elif kind == "peer_dead":
+            dead.append((rank, ev.get("peer")))
+    # the "agreed save step": under coordinated preemption every rank
+    # saves the same step — report it when the saves agree
+    agreed = None
+    if saves and len(set(saves.values())) == 1:
+        agreed = next(iter(saves.values()))
+    return {
+        "n_events": len(events),
+        "ranks": ranks,
+        "phases": phases,
+        "coord": coord,
+        "retries": retries,
+        "faults": faults,
+        "checkpoints": {"last_save_by_rank": saves,
+                        "agreed_step": agreed,
+                        "promoted": sorted(set(promoted)),
+                        "restored": sorted(set(restored))},
+        "epochs_by_rank": epochs,
+        "nonfinite_steps": nonfinite,
+        "preempt_signalled": signalled,
+        "peer_dead": dead,
+    }
+
+
+def _fmt_fields(ev, skip=("t", "seq", "rank", "kind")):
+    parts = []
+    for k, v in ev.items():
+        if k in skip:
+            continue
+        if isinstance(v, float):
+            v = round(v, 4)
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render(directory, last_n=10):
+    """Human-readable report: summary + the last-N events per host."""
+    events = read_events(directory)
+    lines = [f"# dist_keras_tpu run report — {directory}"]
+    if not events:
+        lines.append("no events found (is DK_OBS_DIR right? did the "
+                     "run export it?)")
+        return "\n".join(lines)
+    s = summarize(events)
+    t0 = events[0].get("t", 0.0)
+    lines.append(f"{s['n_events']} events from "
+                 f"{len(s['ranks'])} host(s), spanning "
+                 f"{events[-1].get('t', t0) - t0:.1f}s")
+    for rank in sorted(s["ranks"]):
+        row = s["ranks"][rank]
+        stale = ""
+        if row["last_t"] is not None:
+            age = events[-1].get("t", row["last_t"]) - row["last_t"]
+            if age > 1.0:
+                stale = (f"  << went quiet {age:.1f}s before the end "
+                         f"(last: {row['last_kind']})")
+        lines.append(f"  rank {rank}: {row['events']} events, "
+                     f"last kind {row['last_kind']}{stale}")
+    if s["preempt_signalled"]:
+        for rank, signum in sorted(s["preempt_signalled"].items()):
+            lines.append(f"preemption: rank {rank} got signal {signum}")
+        if s["checkpoints"]["agreed_step"] is not None:
+            lines.append("agreed save step: "
+                         f"{s['checkpoints']['agreed_step']}")
+    if s["checkpoints"]["last_save_by_rank"]:
+        lines.append(f"checkpoints: last save by rank "
+                     f"{s['checkpoints']['last_save_by_rank']}, "
+                     f"promoted {s['checkpoints']['promoted']}, "
+                     f"restored {s['checkpoints']['restored']}")
+    if s["phases"]:
+        lines.append("phases (spans):")
+        for name in sorted(s["phases"]):
+            p = s["phases"][name]
+            lines.append(f"  {name}: n={p['count']} "
+                         f"total={p['total_s']:.3f}s "
+                         f"max={p['max_s']:.3f}s")
+    if s["coord"]:
+        lines.append("coordination ops:")
+        for name in sorted(s["coord"]):
+            p = s["coord"][name]
+            lines.append(f"  {name}: n={p['count']} "
+                         f"total={p['total_s']:.3f}s "
+                         f"max={p['max_s']:.3f}s")
+    if s["retries"]:
+        lines.append("retries: " + ", ".join(
+            f"{k} x{v['attempts']}"
+            + (f" (EXHAUSTED x{v['exhausted']})" if v["exhausted"]
+               else "")
+            for k, v in sorted(s["retries"].items())))
+    if s["faults"]:
+        lines.append("faults fired: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(s["faults"].items())))
+    if s["nonfinite_steps"]:
+        lines.append(f"nonfinite steps: {s['nonfinite_steps']}")
+    if s["peer_dead"]:
+        lines.append("dead-peer reports: " + ", ".join(
+            f"rank {r} saw peer {p} die" for r, p in s["peer_dead"]))
+    # the tail per host — what each host was doing when the run ended
+    by_rank = {}
+    for ev in events:
+        by_rank.setdefault(int(ev.get("rank", 0)), []).append(ev)
+    for rank in sorted(by_rank):
+        lines.append(f"last {last_n} events, rank {rank}:")
+        for ev in by_rank[rank][-last_n:]:
+            ts = ev.get("t")
+            stamp = (f"+{ts - t0:9.3f}s" if ts is not None
+                     else " " * 11)
+            lines.append(f"  {stamp} {ev.get('kind', '?'):<14} "
+                         f"{_fmt_fields(ev)}")
+    return "\n".join(lines)
+
+
+def write_report(directory, out_path=None, last_n=10):
+    """Render and write ``report.txt`` beside the event files (or to
+    ``out_path``); returns the path.  The leader calls this at the end
+    of a run so the artifact exists without any post-hoc CLI step."""
+    text = render(directory, last_n=last_n)
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.abspath(os.path.expanduser(str(directory))),
+            "report.txt")
+    tmp = f"{out_path}.tmp.{os.getpid()}.{int(time.time() * 1e6)}"
+    with open(tmp, "w") as f:
+        f.write(text + "\n")
+    os.replace(tmp, out_path)
+    return out_path

@@ -1,0 +1,105 @@
+"""Span tracing — nested named regions stamped into the event stream.
+
+``span("ckpt.save")`` wraps a block with ``span_begin`` / ``span_end``
+events (the end event carries ``duration_s``), nests — the emitted name
+is the dot-joined path of every open span on this thread — and records
+the duration into ``metrics.histogram("span.<path>")`` so the run report
+can summarize per-phase time without re-deriving it from timestamps.
+
+When a **device trace is active** (``utils.profiling.trace``), each span
+additionally opens a ``jax.profiler.TraceAnnotation`` so the same names
+show up inside the XProf/TensorBoard timeline — one annotation
+vocabulary for both the host-side event log and the device trace.
+``utils.profiling.trace`` flips :func:`set_device_trace`; nothing here
+imports jax unless that flag is on, so spans stay usable in processes
+that never touch a device (the launcher, the report CLI).
+
+Zero-cost contract: with ``DK_OBS_DIR`` unset and no device trace, a
+span is a single shared no-op context manager — no clock read, no
+allocation beyond the generator frame.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from dist_keras_tpu.observability import events, metrics
+
+_tls = threading.local()           # per-thread open-span name stack
+_device_trace_active = False       # toggled by utils.profiling.trace
+
+
+def set_device_trace(active):
+    """Record whether a ``jax.profiler`` device trace is running —
+    spans forward to ``TraceAnnotation`` only while it is."""
+    global _device_trace_active
+    _device_trace_active = bool(active)
+
+
+def device_trace_active():
+    return _device_trace_active
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def _noop():
+    yield
+
+
+_NOOP = _noop  # one shared factory; the generator frame is the only cost
+
+
+@contextlib.contextmanager
+def _span_impl(name, fields):
+    st = _stack()
+    st.append(str(name))
+    path = ".".join(st)
+    ann = None
+    if _device_trace_active:
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(path)
+            ann.__enter__()
+        except Exception:  # the device trace must not break host spans
+            ann = None
+    events.emit("span_begin", span=path, **fields)
+    t0 = time.perf_counter()
+    try:
+        yield path
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:  # pragma: no cover - profiler teardown
+                pass
+        events.emit("span_end", span=path, duration_s=dt, **fields)
+        if events.enabled():
+            metrics.histogram(f"span.{path}").observe(dt)
+        st.pop()
+
+
+def span(name, **fields):
+    """Context manager: a named, nested, timed region.
+
+    >>> with span("train.run"):
+    ...     with span("chunk", i=0):
+    ...         ...   # events: train.run, train.run.chunk
+    """
+    if not events.enabled() and not _device_trace_active:
+        return _NOOP()
+    return _span_impl(name, fields)
+
+
+def current_path():
+    """The dot-joined open-span path on this thread ('' at top level)."""
+    return ".".join(_stack())

@@ -54,7 +54,23 @@ import time
 
 from dist_keras_tpu.resilience.faults import fault_point
 
-DEFAULT_TIMEOUT_S = float(os.environ.get("DK_COORD_TIMEOUT_S", "120"))
+
+def default_timeout_s():
+    """THE collective-deadline knob: ``DK_COORD_TIMEOUT_S`` (seconds,
+    default 120) — re-read per call so a launcher-exported value wins
+    regardless of import order, shared by every consensus op here, the
+    checkpoint commit wait, and ``comm.barrier``'s default.  A
+    malformed value falls back to 120 rather than crashing a worker
+    mid-run."""
+    try:
+        return float(os.environ.get("DK_COORD_TIMEOUT_S", "120"))
+    except ValueError:
+        return 120.0
+
+
+# import-time snapshot kept for back-compat readers; new code should
+# call default_timeout_s() (the per-call read)
+DEFAULT_TIMEOUT_S = default_timeout_s()
 
 
 class BarrierTimeout(TimeoutError):
@@ -259,6 +275,8 @@ class Coordinator:
         return [value]
 
     def _guarded_allgather(self, value, timeout_s, what):
+        from dist_keras_tpu.observability import events
+
         if self._poisoned:
             raise RuntimeError(
                 "coordinator is poisoned: a previous collective timed "
@@ -266,11 +284,39 @@ class Coordinator:
                 "in the cluster's op stream is unknowable — restart "
                 "the process (new DK_COORD_SESSION) instead of "
                 "issuing further collectives")
+        t0 = time.perf_counter()
         try:
-            return self._allgather(value, timeout_s, what)
+            out = self._allgather(value, timeout_s, what)
         except (PeerLost, BarrierTimeout) as e:
             self._poisoned = str(e)
+            # the op that timed out is exactly what a post-mortem needs:
+            # the merged report shows every OTHER host's last op too
+            events.emit("coord_error", op=what, world=self.world,
+                        error=type(e).__name__,
+                        duration_s=time.perf_counter() - t0,
+                        ranks=getattr(e, "ranks", ()))
             raise
+        events.emit("coord", op=what, world=self.world,
+                    duration_s=time.perf_counter() - t0)
+        return out
+
+    def _note_dead(self, ranks):
+        """Emit the stale->dead transition ONCE per peer per process —
+        ``stale_peers`` runs on every probe tick, and a dead host must
+        not spam the event log once per poll."""
+        if not ranks:
+            return ranks
+        known = getattr(self, "_reported_dead", None)
+        if known is None:
+            known = self._reported_dead = set()
+        fresh = [r for r in ranks if r not in known]
+        if fresh:
+            from dist_keras_tpu.observability import events
+
+            known.update(fresh)
+            for r in fresh:
+                events.emit("peer_dead", peer=r, world=self.world)
+        return ranks
 
     def any_flag(self, flag, timeout_s=None):
         """True iff ANY host passed a truthy flag (bool OR)."""
@@ -346,7 +392,7 @@ class JaxCoordinator(Coordinator):
         def gather():
             return multihost_utils.process_allgather(payload)
 
-        out = with_deadline(gather, timeout_s or DEFAULT_TIMEOUT_S,
+        out = with_deadline(gather, timeout_s or default_timeout_s(),
                             what, self.stale_peers)
         vals = [float(v) for v in np.asarray(out).reshape(-1)]
         if value is None:
@@ -361,8 +407,8 @@ class JaxCoordinator(Coordinator):
         d = os.environ.get("DK_COORD_DIR")
         if not d:
             return []
-        return dead_peers(_session_root(d), self.world,
-                          require_file=True)
+        return self._note_dead(dead_peers(_session_root(d), self.world,
+                                          require_file=True))
 
 
 def _coord_env(var):
@@ -439,9 +485,10 @@ class FileCoordinator(Coordinator):
                                  heartbeat_interval_s).start()
 
     def stale_peers(self):
-        return dead_peers(self.directory, self.world,
-                          stale_after_s=self.stale_after_s,
-                          require_file=True)
+        return self._note_dead(
+            dead_peers(self.directory, self.world,
+                       stale_after_s=self.stale_after_s,
+                       require_file=True))
 
     def _allgather(self, value, timeout_s, what):
         op, self._op = self._op, self._op + 1
@@ -468,7 +515,7 @@ class FileCoordinator(Coordinator):
             return sorted(set(range(self.world)) - set(got))
 
         wait_for_peers(
-            missing, timeout_s or DEFAULT_TIMEOUT_S,
+            missing, timeout_s or default_timeout_s(),
             f"{what} (op {op})", poll_s=self.poll_s,
             stale_fn=self.stale_peers)
         if self.rank == 0 and op and op % 16 == 0:

@@ -261,6 +261,12 @@ def fault_point(name, value=_MISSING):
     if spec is None:
         return None if value is _MISSING else value
     spec.fired += 1
+    # a FIRING fault is rare and interesting — record it (the unarmed
+    # fast path above stays one dict lookup; lazy import keeps this
+    # module import-light for subprocess workers)
+    from dist_keras_tpu.observability import events
+    events.emit("fault", point=name, call=count, action=spec.action,
+                exc=spec.exc.__name__)
     if spec.action == "raise":
         raise spec.exc(
             f"fault injected at point {name!r} (call #{count})")

@@ -65,6 +65,11 @@ def check_losses(trainer, arr, units_done=None):
         return False
     trainer.nonfinite_steps += bad
     policy = getattr(trainer, "nan_policy", None)
+    from dist_keras_tpu.observability import events, metrics
+
+    metrics.counter("train.nonfinite_steps").inc(bad)
+    events.emit("nonfinite", count=bad, units_done=units_done,
+                policy=policy)
     if policy == "raise":
         hint = ""
         if getattr(trainer, "checkpoint_dir", None):

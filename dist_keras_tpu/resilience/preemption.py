@@ -66,6 +66,12 @@ _prev = {}              # signum -> previous handler (install/restore)
 
 
 def _handler(signum, frame):
+    # NO event emission here: the handler runs re-entrantly on the main
+    # thread, and the observability writer takes plain (non-reentrant)
+    # locks — interrupting an in-progress emit and then emitting from
+    # the handler would deadlock the process.  The dispatch loop emits
+    # the "preempt" event when it notices the flag at its next boundary
+    # (chunking.py), which also stamps WHERE the run was.
     global _requested
     first = _requested is None
     if first:
@@ -152,6 +158,11 @@ def request(signum=signal.SIGTERM):
     global _requested
     if _requested is None:
         _requested = int(signum)
+        # unlike the real handler this runs in ordinary thread context,
+        # so recording the signal directly is safe
+        from dist_keras_tpu.observability import events
+
+        events.emit("preempt_signal", signum=int(signum))
 
 
 def clear():

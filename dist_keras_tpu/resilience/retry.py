@@ -37,7 +37,8 @@ class RetryPolicy:
     def __init__(self, attempts=3, backoff=0.1, multiplier=2.0,
                  max_delay=30.0, jitter=0.0, timeout=None,
                  retryable=(OSError,), sleep=time.sleep,
-                 clock=time.monotonic, on_retry=None, seed=None):
+                 clock=time.monotonic, on_retry=None, seed=None,
+                 name=None):
         if int(attempts) < 1:
             raise ValueError(f"attempts={attempts} must be >= 1")
         if float(backoff) < 0 or float(max_delay) < 0:
@@ -54,6 +55,11 @@ class RetryPolicy:
         self.sleep = sleep
         self.clock = clock
         self.on_retry = on_retry
+        # name: which retry surface this is ("checkpoint.save",
+        # "job.rsync", ...) — stamped on the observability events and
+        # the per-surface metrics counters below; None = anonymous
+        # (events still fire, counters aggregate under "retry")
+        self.name = name
         # seed=None derives from the pid so concurrent processes
         # genuinely de-synchronize (the anti-thundering-herd property);
         # an explicit seed replays the identical schedule for tests
@@ -72,6 +78,12 @@ class RetryPolicy:
     def call(self, fn, *args, **kwargs):
         """Run ``fn`` under this policy; re-raises the last error after
         the attempts/deadline budget is spent."""
+        # lazy: events/metrics must never be an import cycle hazard for
+        # the low-level retry primitive (and emit() is a no-op boolean
+        # check when DK_OBS_DIR is unset)
+        from dist_keras_tpu.observability import events, metrics
+
+        surface = self.name or "retry"
         deadline = (None if self.timeout is None
                     else self.clock() + self.timeout)
         last = None
@@ -88,10 +100,16 @@ class RetryPolicy:
                     if remaining <= 0:
                         break  # out of time: don't start another attempt
                     d = min(d, remaining)
+                metrics.counter(f"{surface}.retries").inc()
+                events.emit("retry", name=surface, attempt=attempt,
+                            error=type(e).__name__, delay_s=d)
                 if self.on_retry is not None:
                     self.on_retry(attempt, e, d)
                 if d > 0:
                     self.sleep(d)
+        metrics.counter(f"{surface}.exhausted").inc()
+        events.emit("retry_exhausted", name=surface, attempts=attempt,
+                    error=type(last).__name__)
         try:
             last._retry_attempts = attempt
         except AttributeError:  # pragma: no cover - __slots__ exceptions
@@ -106,7 +124,7 @@ def retry_call(fn, *args, policy=None, **kwargs):
 
 def retry(fn=None, *, attempts=3, backoff=0.1, multiplier=2.0,
           max_delay=30.0, jitter=0.0, timeout=None, retryable=(OSError,),
-          sleep=time.sleep, on_retry=None, seed=0):
+          sleep=time.sleep, on_retry=None, seed=0, name=None):
     """Decorator form: ``@retry`` or ``@retry(attempts=5, ...)``.
 
     The policy is built once at decoration time; its jitter PRNG is
@@ -117,7 +135,7 @@ def retry(fn=None, *, attempts=3, backoff=0.1, multiplier=2.0,
                          multiplier=multiplier, max_delay=max_delay,
                          jitter=jitter, timeout=timeout,
                          retryable=retryable, sleep=sleep,
-                         on_retry=on_retry, seed=seed)
+                         on_retry=on_retry, seed=seed, name=name)
 
     def deco(f):
         import functools

@@ -91,9 +91,30 @@ class Trainer:
     # ---- timing (trainers.py:~60) ----
     def record_training_start(self):
         self._t_start = time.time()
+        from dist_keras_tpu.observability import events
+
+        events.emit("train_start", trainer=type(self).__name__,
+                    num_epoch=self.num_epoch,
+                    batch_size=self.batch_size)
 
     def record_training_end(self):
         self._t_stop = time.time()
+        from dist_keras_tpu.observability import events
+
+        events.emit("train_end", trainer=type(self).__name__,
+                    seconds=self.get_training_time())
+        # leader-side merged report: when the obs dir is shared
+        # storage, rank 0 leaves report.txt next to the logs at run
+        # end — the post-hoc CLI remains for collected/per-host dirs.
+        # Best-effort like every emit: telemetry must not kill a run
+        # that just finished training.
+        if events.rank() == 0:
+            try:
+                from dist_keras_tpu.observability import report
+
+                report.write_report(events.obs_dir())
+            except Exception:  # pragma: no cover - fs failure
+                pass
 
     def get_training_time(self):
         if self._t_start is None or self._t_stop is None:
@@ -302,6 +323,15 @@ class Trainer:
         }
         self._nonfinite_emitted = self.nonfinite_steps
         self.metrics.append(logs)
+        # the epoch boundary is the natural telemetry cadence: one
+        # typed event carrying the epoch record, plus a snapshot of the
+        # process-wide metrics registry riding the same stream (both
+        # no-ops when DK_OBS_DIR is unset)
+        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.observability import metrics as obs_metrics
+
+        events.emit("epoch_end", trainer=type(self).__name__, **logs)
+        obs_metrics.emit_snapshot(epoch=epochs_done)
         for cb in self.callbacks:
             hook = getattr(cb, "on_epoch_end", cb)
             hook(self, epochs_done, logs)

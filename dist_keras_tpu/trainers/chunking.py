@@ -26,6 +26,7 @@ import time
 
 import numpy as np
 
+from dist_keras_tpu.observability import events as obs_events
 from dist_keras_tpu.resilience import coordination, preemption
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.guards import check_losses
@@ -336,6 +337,10 @@ class ChunkRunner:
             for i, K in enumerate(self.plan):
                 sig = (preemption.requested()
                        if tr.handle_preemption else None)
+                # did THIS host's OS deliver the signal?  (vs adopting
+                # it from the vote below) — the report uses this to
+                # attribute the preemption to the right rank
+                signalled = sig is not None
                 if tr.handle_preemption:
                     # boundary vote: did ANY host see the signal?  A
                     # host whose own flag is clear adopts SIGTERM — its
@@ -361,6 +366,16 @@ class ChunkRunner:
                     # NaN sentinel ("raise" aborts inside _retire_one;
                     # "halt" sets the flag) — a halted run's diverged
                     # state must NOT be persisted here either.
+                    # (this is also where the preemption SIGNAL becomes
+                    # an event: the handler itself must not emit — see
+                    # preemption._handler — so the boundary that notices
+                    # the flag stamps signum + where the run was.
+                    # adopted=True marks a host that only learned of the
+                    # signal through the vote: the report attributes the
+                    # preemption to the non-adopted rank(s) only)
+                    obs_events.emit("preempt", signum=int(sig),
+                                    units_done=units_done,
+                                    adopted=not signalled)
                     while pending:
                         _retire_one()
                     if coord.world > 1:
@@ -379,11 +394,26 @@ class ChunkRunner:
                         # scheduler restarts a pod whose checkpoint is
                         # fully committed, never torn
                         coord.barrier("preempt_exit")
+                    obs_events.emit("preempt_exit", signum=int(sig),
+                                    saved_step=saved)
+                    # the run ENDED here: stamp the wall clock (the
+                    # trained-time answer is truthful — training
+                    # stopped at this boundary) — which also writes
+                    # the leader's merged report.txt; the flagship
+                    # post-mortem artifact must exist precisely for
+                    # ABNORMAL exits, not only clean completions
+                    tr.record_training_end()
                     raise Preempted(sig, saved_step=saved)
                 data = (self.feed.get(i) if self.feed is not None
                         else resident_data)
                 losses = dispatch(i, K, units_done, data)
                 units_done += K
+                # per-CHUNK (not per-step — steps live inside the
+                # compiled scan) breadcrumb: the last of these in a
+                # host's log is where a hung run stopped
+                obs_events.emit("chunk", i=i, units=K,
+                                units_done=units_done,
+                                streamed=self.feed is not None)
                 pending.append((i, losses, units_done))
                 if self.feed is not None:
                     # retire the previous chunk BEFORE prefetching the
@@ -430,6 +460,7 @@ class ChunkRunner:
                         acc_dt, acc_samples)
                     acc_losses, acc_dt, acc_samples = [], 0.0, 0
                 if self._halt:
+                    obs_events.emit("nan_halt", units_done=units_done)
                     # halting mid-epoch: emit the partial epoch too
                     # (numbered as the epoch in progress) so the
                     # nonfinite ledger reaches trainer.metrics — a

@@ -6,10 +6,14 @@ already reproduces.  This module adds the TPU-native layer on top:
 
 - ``trace(logdir)``: context manager around ``jax.profiler`` producing an
   XProf/TensorBoard trace of everything inside (compiled steps, collectives,
-  transfers).
+  transfers).  While it is open, ``observability.span`` regions forward
+  their names into the device trace as ``TraceAnnotation``s.
 - ``annotate(name)``: named region that shows up inside the trace.
 - ``StepTimer``: cheap host-side per-call timer with summary stats, for
-  loops the profiler would be too heavy for.
+  loops the profiler would be too heavy for.  Since the observability PR
+  it is a thin wrapper over ``observability.metrics.Histogram`` — the
+  process-wide registry every subsystem shares — keeping its historical
+  context-manager API.
 """
 
 from __future__ import annotations
@@ -18,16 +22,24 @@ import contextlib
 import time
 
 import jax
-import numpy as np
+
+from dist_keras_tpu.observability import metrics as _metrics
+from dist_keras_tpu.observability import spans as _spans
 
 
 @contextlib.contextmanager
 def trace(logdir):
-    """Capture a device trace into ``logdir`` (view with TensorBoard)."""
+    """Capture a device trace into ``logdir`` (view with TensorBoard).
+
+    Also flips the span-forwarding flag so every
+    ``observability.span(...)`` opened inside shows up as a
+    ``TraceAnnotation`` in the captured timeline."""
     jax.profiler.start_trace(str(logdir))
+    _spans.set_device_trace(True)
     try:
         yield
     finally:
+        _spans.set_device_trace(False)
         jax.profiler.stop_trace()
 
 
@@ -37,26 +49,54 @@ def annotate(name):
 
 
 class StepTimer:
-    def __init__(self):
-        self.times = []
+    """Per-call wall-clock timer: ``with timer: ...`` per step.
+
+    A named timer (``StepTimer(name="train.step")``) registers its
+    histogram in the process-wide metrics registry, so its samples ride
+    the epoch-boundary snapshots into the event stream; an anonymous
+    one keeps a private histogram (the historical behavior).
+    """
+
+    def __init__(self, name=None):
+        self._hist = (_metrics.histogram(name) if name
+                      else _metrics.Histogram())
         self._t0 = None
+
+    @property
+    def times(self):
+        """The recorded durations (seconds) — historical list API."""
+        return self._hist.samples
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self.times.append(time.perf_counter() - self._t0)
+        self._hist.observe(time.perf_counter() - self._t0)
         return False
 
+    def observe(self, seconds):
+        """Record an externally-measured duration."""
+        self._hist.observe(seconds)
+
+    def reset(self):
+        """Drop every recorded sample (windowed use: reset per epoch)."""
+        self._hist.reset()
+
     def summary(self):
-        arr = np.asarray(self.times)
-        if arr.size == 0:
-            return {"count": 0}
+        """-> {count, mean_s, p50_s, p95_s, p99_s, max_s, total_s}.
+
+        A zero-length window returns ``count: 0`` with ``None`` stats
+        (``total_s: 0.0``) — guarded the same way the metrics registry
+        and ``Trainer._emit_epoch_end`` guard their empty windows,
+        instead of raising from the percentile math."""
+        s = self._hist.summary()
         return {
-            "count": int(arr.size),
-            "mean_s": float(arr.mean()),
-            "p50_s": float(np.percentile(arr, 50)),
-            "p95_s": float(np.percentile(arr, 95)),
-            "total_s": float(arr.sum()),
+            "count": s["count"],
+            "mean_s": s["mean"],
+            "p50_s": s["p50"],
+            "p95_s": s["p95"],
+            "p99_s": s["p99"],
+            "max_s": s["max"],
+            "total_s": s["total"],
         }

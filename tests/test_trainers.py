@@ -287,3 +287,66 @@ def test_id_pins_pruned_with_cache_eviction():
         Trainer._id_pin_refs.clear()
         Trainer._id_pin_refs.update(saved[2])
         Trainer._jit_cache_max = saved[3]
+
+
+# ------------------------------------------------- _emit_epoch_end contract
+def _bare_trainer(**kw):
+    from dist_keras_tpu.trainers.base import Trainer
+
+    return Trainer(_model(), **kw)
+
+
+def test_emit_epoch_end_skip_averages_finite_losses_only():
+    """nan_policy='skip': one exploding batch was skipped on-device, so
+    the epoch metric must average the finite losses — any other policy
+    keeps the plain (NaN-poisoned) mean."""
+    t = _bare_trainer(nan_policy="skip")
+    t._emit_epoch_end(1, [1.0, np.nan, 3.0], seconds=2.0, samples=64)
+    assert t.metrics[-1]["mean_loss"] == pytest.approx(2.0)
+
+    t2 = _bare_trainer(nan_policy=None)
+    t2._emit_epoch_end(1, [1.0, np.nan, 3.0], seconds=2.0, samples=64)
+    assert np.isnan(t2.metrics[-1]["mean_loss"])
+
+
+def test_emit_epoch_end_skip_all_nonfinite_window_guarded():
+    t = _bare_trainer(nan_policy="skip")
+    t._emit_epoch_end(1, [np.nan, np.inf], seconds=0.0, samples=0)
+    logs = t.metrics[-1]
+    # empty finite window and a zero-second clock both degrade to NaN,
+    # never a ZeroDivision/numpy warning
+    assert np.isnan(logs["mean_loss"])
+    assert np.isnan(logs["samples_per_sec"])
+
+
+def test_emit_epoch_end_nonfinite_ledger_vs_cumulative():
+    """metrics[...]['nonfinite_steps'] is the per-epoch delta; the
+    cumulative total lives on trainer.nonfinite_steps."""
+    t = _bare_trainer(nan_policy="skip")
+    t.nonfinite_steps = 2
+    t._emit_epoch_end(1, [1.0], seconds=1.0, samples=8)
+    assert t.metrics[-1]["nonfinite_steps"] == 2
+    t.nonfinite_steps = 5  # 3 more since the last emit
+    t._emit_epoch_end(2, [1.0], seconds=1.0, samples=8)
+    assert t.metrics[-1]["nonfinite_steps"] == 3
+    t._emit_epoch_end(3, [1.0], seconds=1.0, samples=8)
+    assert t.metrics[-1]["nonfinite_steps"] == 0
+    assert t.nonfinite_steps == 5  # cumulative untouched by the emits
+
+
+def test_emit_epoch_end_invokes_both_callback_forms():
+    seen = []
+
+    class EpochHook:
+        def on_epoch_end(self, trainer, epoch, logs):
+            seen.append(("object", epoch, logs["mean_loss"]))
+
+    def plain(trainer, epoch, logs):
+        seen.append(("plain", epoch, logs["mean_loss"]))
+
+    t = _bare_trainer(callbacks=[EpochHook(), plain])
+    t._emit_epoch_end(4, [2.0, 4.0], seconds=1.0, samples=16)
+    assert seen == [("object", 4, 3.0), ("plain", 4, 3.0)]
+    # logs passed to callbacks are the SAME record appended to metrics
+    assert t.metrics[-1]["epoch"] == 4
+    assert t.metrics[-1]["samples_per_sec"] == pytest.approx(16.0)
