@@ -15,6 +15,7 @@ from dist_keras_tpu import (
     ops,
     parallel,
     resilience,
+    serving,
     trainers,
     utils,
 )
@@ -43,7 +44,8 @@ from dist_keras_tpu.trainers import (
 )
 
 __all__ = [
-    "data", "models", "ops", "parallel", "resilience", "trainers", "utils",
+    "data", "models", "ops", "parallel", "resilience", "serving",
+    "trainers", "utils",
     "Dataset", "ModelPredictor",
     "MinMaxTransformer", "OneHotTransformer", "LabelIndexTransformer",
     "ReshapeTransformer", "DenseTransformer", "StandardScaleTransformer",

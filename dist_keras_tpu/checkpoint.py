@@ -288,6 +288,28 @@ class Checkpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def wait_for_step_after(self, step=None, timeout_s=None, poll_s=0.1):
+        """Block until a step NEWER than ``step`` is promoted; -> that
+        step, or None at the deadline.  STRICTLY read-only (it polls
+        :meth:`latest_step`, which only ever sees committed/promoted
+        directories), so a serving-side watcher can poll a live
+        training run's directory forever without interfering with the
+        writer — ``serving.reload.CheckpointWatcher`` probes it with
+        ``timeout_s=0`` (one non-blocking check per loop tick, keeping
+        its own stoppable cadence); pass a real timeout to block.
+        ``step=None`` waits for the first checkpoint ever."""
+        import time
+
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + float(timeout_s))
+        while True:
+            latest = self.latest_step()
+            if latest is not None and (step is None or latest > step):
+                return latest
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(float(poll_s))
+
     def save(self, step, state):
         """Atomic, retried commit: tmp-dir write -> fsync -> rename.
 

@@ -12,6 +12,8 @@ from dist_keras_tpu.data.streaming import (
     SocketSource,
     StreamingPredictor,
     StreamSource,
+    pack_rows,
+    pad_rows,
     send_rows,
 )
 from dist_keras_tpu.data.transformers import (
@@ -32,5 +34,5 @@ __all__ = [
     "Predictor", "ModelPredictor",
     "Evaluator", "AccuracyEvaluator", "LossEvaluator", "AUCEvaluator",
     "StreamSource", "QueueSource", "SocketSource", "KafkaSource",
-    "StreamingPredictor", "send_rows",
+    "StreamingPredictor", "send_rows", "pack_rows", "pad_rows",
 ]

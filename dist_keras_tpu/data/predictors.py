@@ -70,6 +70,14 @@ class ModelPredictor(Predictor):
         else:
             fn = jax.jit(lambda p, xb: apply_fn(p, xb))
 
+        if n == 0:
+            # empty dataset: run ONE zero batch through the same jitted
+            # path so the output column carries the model's real output
+            # shape/dtype (an empty np.concatenate would raise, and a
+            # guessed shape would break downstream evaluators)
+            dummy = jnp.zeros((bs,) + x.shape[1:], jnp.float32)
+            out = np.asarray(fn(params, dummy))[:0]
+            return dataset.with_column(self.output_col, out)
         outs = []
         for i in range(0, len(x), bs):
             outs.append(np.asarray(fn(params, jnp.asarray(x[i:i + bs]))))

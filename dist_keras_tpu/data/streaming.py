@@ -45,6 +45,27 @@ from dist_keras_tpu.utils.serialization import deserialize_model
 _SENTINEL = object()
 
 
+def pad_rows(x, batch_size):
+    """Pad a (n, ...) row block up to ``batch_size`` by replicating the
+    last row — the fixed-shape device batch every online path here
+    dispatches (the pad is stripped from the output after), shared by
+    :class:`StreamingPredictor` and ``serving.ServingEngine``."""
+    n = len(x)
+    pad = batch_size - n
+    if pad < 0:
+        raise ValueError(f"{n} rows exceed batch_size={batch_size}")
+    if pad:
+        x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
+    return x
+
+
+def pack_rows(rows, batch_size):
+    """Stack a list of feature rows into one fixed-shape padded batch;
+    -> ``(x (batch_size, ...), n)`` with ``n`` the real row count."""
+    n = len(rows)
+    return pad_rows(np.stack(rows), batch_size), n
+
+
 class StreamSource:
     """Pull interface: ``get(timeout) -> row | None`` (None = nothing yet),
     ``closed`` property ends the stream."""
@@ -288,11 +309,7 @@ class StreamingPredictor(Predictor):
                 chunk, pending = pending[:n], pending[n:]
                 deadline = (time.monotonic() + self.max_latency_s
                             if pending else None)
-                x = np.stack(chunk)
-                pad = self.batch_size - n
-                if pad:
-                    x = np.concatenate(
-                        [x, np.repeat(x[-1:], pad, axis=0)])
+                x, n = pack_rows(chunk, self.batch_size)
                 preds = np.asarray(self._predict(jnp.asarray(x)))[:n]
                 self._m_batches.inc()
                 self._m_rows.inc(n)

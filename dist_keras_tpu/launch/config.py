@@ -59,6 +59,10 @@ class JobConfig:
     # as DK_OBS_DIR; Job.collect_obs(dest) rsyncs the logs back and
     # `python -m dist_keras_tpu.observability` merges the timeline
     obs_dir: str | None = None
+    # serving-job port, exported per host as DK_SERVE_PORT: an
+    # entrypoint that starts serving.ServingServer(port=None) binds it
+    # on every host, so one descriptor launches a serving fleet
+    serve_port: int | None = None
 
     # operator-facing JSON surface: validate types, not just names — a
     # string where a list belongs (hosts: "localhost") would otherwise
@@ -71,7 +75,8 @@ class JobConfig:
               "launch_retries": int,
               "coord_dir": (str, type(None)),
               "coord_timeout_s": (int, float, type(None)),
-              "obs_dir": (str, type(None))}
+              "obs_dir": (str, type(None)),
+              "serve_port": (int, type(None))}
 
     @classmethod
     def from_dict(cls, d):

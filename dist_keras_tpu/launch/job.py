@@ -28,6 +28,13 @@ host, whose training process then heartbeats
 ``"job.heartbeat"`` fault point) and gains real cluster consensus for
 coordinated preemption.  ``Job.dead_hosts()`` reads the same files from
 the launcher side and names WHICH host went dark.
+
+Round 9 — serving jobs + live monitoring: ``serve_port`` exports
+``DK_SERVE_PORT`` per host (an entrypoint that starts
+``dist_keras_tpu.serving.ServingServer(port=None)`` binds it), and
+``Job.monitor(interval_s)`` is the launcher-side live loop tailing
+``dead_hosts()`` plus the merged observability report, printing only
+transitions.
 """
 
 from __future__ import annotations
@@ -74,7 +81,8 @@ class Job:
                  hosts=(), coordinator_port=8476, num_processes=None,
                  remote_root="~/jobs", python="python3", dry_run=False,
                  retries=2, retry_backoff=0.5, launch_retries=0,
-                 coord_dir=None, coord_timeout_s=None, obs_dir=None):
+                 coord_dir=None, coord_timeout_s=None, obs_dir=None,
+                 serve_port=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -147,6 +155,12 @@ class Job:
             raise ValueError(
                 f"obs_dir {obs_dir!r} must match [A-Za-z0-9._/~-]+")
         self.obs_dir = obs_dir
+        # serve_port: when set, every host's env gets DK_SERVE_PORT, so
+        # an entrypoint that starts a serving front end
+        # (dist_keras_tpu.serving.ServingServer with port=None) binds
+        # the same operator-chosen port on every host — one launch-config
+        # knob turns a training job descriptor into a serving-job one
+        self.serve_port = None if serve_port is None else int(serve_port)
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
@@ -213,6 +227,9 @@ class Job:
             # lands in <obs_dir>/events-rank_{pid}.jsonl (the writer
             # reads its rank from DK_COORD_RANK / JAX_PROCESS_ID)
             env["DK_OBS_DIR"] = str(self.obs_dir)
+        if self.serve_port is not None:
+            # serving plane: ServingServer(port=None) binds this
+            env["DK_SERVE_PORT"] = str(self.serve_port)
         return env
 
     def dead_hosts(self, stale_after_s=None):
@@ -236,6 +253,90 @@ class Job:
             stale_after_s=stale_after_s)
         return [(r, self.hosts[r] if r < len(self.hosts) else None)
                 for r in dead]
+
+    def monitor(self, interval_s=10.0, max_polls=None, out=print,
+                obs_dir=None, stale_after_s=None):
+        """Live monitor loop: tail :meth:`dead_hosts` and the merged
+        observability report, printing TRANSITIONS only — a host going
+        dark or coming back, a rank's event stream advancing (with its
+        latest event kind) or appearing for the first time.  This is
+        the launcher-side "is my pod alive and what is it doing"
+        answer the ROADMAP asked for, without an operator re-running
+        the report CLI in a shell loop.
+
+        ``obs_dir``: a LAUNCHER-READABLE directory of event files — a
+        shared-fs ``self.obs_dir`` works as-is; for per-host local
+        obs dirs point this at a :meth:`collect_obs` destination (all
+        ``host_*`` subdirs merged, or one of them).  Defaults to
+        ``self.obs_dir``.  Either plane may be absent: with no
+        ``coord_dir`` only the event tail is monitored and vice versa.
+
+        ``max_polls`` bounds the loop (tests / one-shot probes); the
+        default None polls forever.  Returns the list of transition
+        strings printed (bounded runs; the forever loop only returns
+        on KeyboardInterrupt)."""
+        from dist_keras_tpu.observability import report as obs_report
+
+        transitions = []
+        prev_dead = set()
+        prev_ranks = {}
+
+        def _note(line):
+            transitions.append(line)
+            if out is not None:
+                out(line)
+
+        polls = 0
+        try:
+            while max_polls is None or polls < max_polls:
+                if self.coord_dir:
+                    try:
+                        dead = set(self.dead_hosts(
+                            stale_after_s=stale_after_s))
+                    except (OSError, ValueError):
+                        dead = prev_dead  # unreadable poll: no verdict
+                    for r, h in sorted(dead - prev_dead):
+                        _note(f"[monitor] host {r} ({h}) went DARK")
+                    for r, h in sorted(prev_dead - dead):
+                        _note(f"[monitor] host {r} ({h}) is back")
+                    prev_dead = dead
+                d = self.obs_dir if obs_dir is None else obs_dir
+                if d and os.path.isdir(os.path.expanduser(str(d))):
+                    # re-reading the whole directory per poll is
+                    # O(retained bytes), which rotation bounds at
+                    # (keep+1) x cap per host — acceptable for a
+                    # monitor cadence of seconds; offset-tailing is the
+                    # upgrade path if an unrotated log ever matters
+                    ranks = obs_report.summarize(
+                        obs_report.read_events(d))["ranks"]
+                    for rank in sorted(ranks):
+                        row, prev = ranks[rank], prev_ranks.get(rank)
+                        delta = (None if prev is None
+                                 else row["events"] - prev["events"])
+                        if prev is None:
+                            _note(f"[monitor] rank {rank}: "
+                                  f"{row['events']} events "
+                                  f"(last: {row['last_kind']})")
+                        elif delta > 0:
+                            _note(f"[monitor] rank {rank}: "
+                                  f"+{delta} events "
+                                  f"(last: {row['last_kind']})")
+                        elif (row["last_t"], row["last_kind"]) != \
+                                (prev["last_t"], prev["last_kind"]):
+                            # rotation trimmed the retained window so
+                            # the COUNT dropped, but the tail moved:
+                            # still an advance, never a bogus "+-N"
+                            _note(f"[monitor] rank {rank}: advanced "
+                                  f"(last: {row['last_kind']})")
+                        # count shrank with an unchanged tail: rotation
+                        # only — no transition
+                    prev_ranks = {k: dict(v) for k, v in ranks.items()}
+                polls += 1
+                if max_polls is None or polls < max_polls:
+                    time.sleep(float(interval_s))
+        except KeyboardInterrupt:  # pragma: no cover - operator ^C
+            pass
+        return transitions
 
     def collect_obs(self, dest):
         """rsync every host's ``obs_dir`` event log back to

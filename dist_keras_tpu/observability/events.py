@@ -31,6 +31,16 @@ Env knobs:
   first emit).  Unset = disabled.
 - ``DK_OBS_FLUSH=1`` — fsync after every line (power-loss durable;
   default is write-per-line, which already survives a process crash).
+- ``DK_OBS_ROTATE_MB`` — size cap per event file: once the active
+  ``events-rank_{i}.jsonl`` exceeds this many MB it is rotated to
+  ``events-rank_{i}.jsonl.1`` (older segments shift to ``.2``, ``.3``,
+  ...) and a fresh file is opened, so a week-long run's log stays
+  bounded.  ``DK_OBS_ROTATE_KEEP`` (default 3) bounds how many rotated
+  segments are retained — total disk per host is at most
+  ``(keep + 1) * cap`` (+ one event).  The report merger reads rotated
+  segments back in order; ``seq`` stays monotonic across rotations, so
+  the merged timeline is seamless.  Unset/0 = never rotate (the
+  pre-round-9 behaviour).
 
 Event schema: every record carries ``t`` (``time.time()``), ``seq`` (a
 per-process monotonic counter — the tiebreaker for same-timestamp
@@ -76,20 +86,69 @@ class EventWriter:
     explicitly; training code should use :func:`emit`.
     """
 
-    def __init__(self, directory, rank=None, fsync=None):
+    def __init__(self, directory, rank=None, fsync=None,
+                 rotate_bytes=None, rotate_keep=None):
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self.rank = _default_rank() if rank is None else int(rank)
         if fsync is None:
             fsync = os.environ.get("DK_OBS_FLUSH", "") \
                 in ("1", "true", "fsync")
         self.fsync = bool(fsync)
+        if rotate_bytes is None:
+            try:
+                rotate_bytes = int(float(
+                    os.environ.get("DK_OBS_ROTATE_MB", "0") or 0) * 2**20)
+            except ValueError:
+                rotate_bytes = 0  # malformed knob: log unbounded, not die
+        self.rotate_bytes = max(0, int(rotate_bytes))  # 0 = never rotate
+        if rotate_keep is None:
+            try:
+                rotate_keep = int(
+                    os.environ.get("DK_OBS_ROTATE_KEEP", "3") or 3)
+            except ValueError:
+                rotate_keep = 3
+        self.rotate_keep = max(1, int(rotate_keep))
         self.path = os.path.join(self.directory,
                                  f"events-rank_{self.rank}.jsonl")
         os.makedirs(self.directory, exist_ok=True)
         self._fd = os.open(self.path,
                            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            self._bytes = os.fstat(self._fd).st_size
+        except OSError:  # pragma: no cover - exotic fs
+            self._bytes = 0
         self._seq = 0
         self._lock = threading.Lock()
+
+    def _rotate(self):
+        """Shift ``path.N`` -> ``path.N+1`` (dropping past ``keep``),
+        retire the active file to ``path.1``, open a fresh one.  Caller
+        holds the lock; ``seq`` keeps counting, so the merged timeline
+        orders seamlessly across segments.
+
+        The OLD fd closes LAST: POSIX renames follow the open file, so
+        every step up to the new ``os.open`` leaves ``self._fd`` valid —
+        a rotation that dies midway (ENOSPC, a log cleaner racing the
+        shifts) keeps appending to the still-open descriptor and simply
+        retries at the next emit, instead of stranding a CLOSED fd
+        number that a later ``os.write`` could spray into whatever
+        unrelated file the process reused it for."""
+        last = f"{self.path}.{self.rotate_keep}"
+        if os.path.exists(last):
+            os.remove(last)
+        for i in range(self.rotate_keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        old = self._fd
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._bytes = 0
+        try:
+            os.close(old)
+        except OSError:  # pragma: no cover - double close
+            pass
 
     def emit(self, kind, **fields):
         """Write one event line.  Raises on failure — the module-level
@@ -103,9 +162,23 @@ class EventWriter:
         # default=str: an event must not be droppable by an exotic field
         # type (numpy scalar, Path, exception instance)
         line = (json.dumps(record, default=str) + "\n").encode("utf-8")
-        os.write(self._fd, line)  # O_APPEND: one atomic line per event
-        if self.fsync:
-            os.fsync(self._fd)
+        if not self.rotate_bytes:
+            # unbounded log: the O_APPEND write alone is the atomicity
+            # story — concurrent writers need no lock at all
+            os.write(self._fd, line)
+            if self.fsync:
+                os.fsync(self._fd)
+            return
+        # size-capped log: the write, the size check and a possible
+        # rotation must be one unit, or a concurrent writer could emit
+        # into a just-retired fd
+        with self._lock:
+            os.write(self._fd, line)
+            if self.fsync:
+                os.fsync(self._fd)
+            self._bytes += len(line)
+            if self._bytes >= self.rotate_bytes:
+                self._rotate()
 
     def close(self):
         try:

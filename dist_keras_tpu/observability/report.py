@@ -23,22 +23,39 @@ import os
 import re
 import time
 
-_FILE_RE = re.compile(r"^events-rank_(\d+)\.jsonl$")
+_FILE_RE = re.compile(r"^events-rank_(\d+)\.jsonl(?:\.(\d+))?$")
 
 
 def event_files(directory):
-    """-> sorted [(rank, path)] of the per-host event files."""
+    """-> [(rank, path)] of the per-host event files, including rotated
+    segments (``events-rank_{i}.jsonl.N`` — produced by the
+    ``DK_OBS_ROTATE_MB`` size cap) and files one level down in
+    ``host_{i}/`` subdirectories (the layout ``Job.collect_obs``
+    rsyncs back, so a collect destination is directly monitorable).
+    Ordered per rank OLDEST segment first (highest ``.N``, then the
+    active file) so a sequential reader sees each host's history in
+    emission order.  The merged timeline re-sorts by (t, rank, seq)
+    anyway; this order is for humans cat-ing the list."""
     directory = os.path.abspath(os.path.expanduser(str(directory)))
     out = []
-    try:
-        names = os.listdir(directory)
-    except OSError:
-        return []
-    for name in names:
+
+    def _scan(d):
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        return [(n, os.path.join(d, n)) for n in names]
+
+    entries = _scan(directory)
+    for name, path in list(entries):
+        if re.match(r"^host_\d+$", name) and os.path.isdir(path):
+            entries.extend(_scan(path))
+    for name, path in entries:
         m = _FILE_RE.match(name)
         if m:
-            out.append((int(m.group(1)), os.path.join(directory, name)))
-    return sorted(out)
+            seg = int(m.group(2)) if m.group(2) else 0
+            out.append(((int(m.group(1)), -seg), path))
+    return [(key[0], path) for key, path in sorted(out)]
 
 
 def read_events(directory):

@@ -43,6 +43,7 @@ The opt-out is the input dtype itself — pass f32 q/k/v and every matmul
 from __future__ import annotations
 
 import functools
+import re
 
 import jax
 import jax.numpy as jnp
@@ -60,6 +61,7 @@ from dist_keras_tpu.ops.attention import attention_with_lse as _ref_with_lse
 from dist_keras_tpu.utils import jax_compat
 
 _NEG_INF = -1e30
+_SANITIZE_RE = re.compile(r"[^A-Za-z0-9_.]")
 
 
 def use_pallas():
@@ -74,6 +76,23 @@ def _require_tpu_helpers():
             "jax.experimental.pallas.tpu is unavailable in this jax build; "
             "the flash kernels need its VMEM scratch allocators even in "
             "interpret mode. Use ops.attention.attention instead.")
+
+
+def _kernel_name(base):
+    """Kernel name carrying the OPEN OBSERVABILITY SPAN path at trace
+    time (``spans.current_path()``), so the XProf/TensorBoard timeline
+    labels each flash kernel with the same vocabulary the host event
+    log uses — a ``train.chunk`` span tracing a compile shows up as
+    ``flash_fwd.train.chunk``, and the device trace and the run report
+    attribute the same region to the same name (the ROADMAP span
+    follow-up).  Resolved when the kernel is TRACED, not per call:
+    naming is free on the hot path, and one jitted executable keeps one
+    name.  Sanitized to the identifier charset mosaic accepts."""
+    from dist_keras_tpu.observability.spans import current_path
+
+    path = current_path()
+    name = f"{base}.{path}" if path else base
+    return _SANITIZE_RE.sub("_", name)
 
 
 def _compiler_params(interpret):
@@ -213,6 +232,7 @@ def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
                         _VMEM((block_q, 1), jnp.float32),
                         _VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        name=_kernel_name("flash_fwd"),
         **_compiler_params(interpret),
     )(q, k, v)
 
@@ -354,6 +374,7 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         out_shape=_sds((bh, tq, d), q.dtype, q),
         scratch_shapes=[_VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        name=_kernel_name("flash_bwd_dq"),
         **_compiler_params(interpret),
     )(q, k, v, do, lse, dl)
     # swapped grid: (bh, kv, q) — index maps read i=kv-block, j=q-block
@@ -372,6 +393,7 @@ def _bwd_call(q, k, v, do, lse, dl, causal, scale, block_q, block_k,
         scratch_shapes=[_VMEM((block_k, d), jnp.float32),
                         _VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
+        name=_kernel_name("flash_bwd_dkv"),
         **_compiler_params(interpret),
     )(q, k, v, do, lse, dl)
     return dq, dk, dv

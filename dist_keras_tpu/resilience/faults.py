@@ -31,6 +31,11 @@ recoverable I/O seam in the framework passes through a named
   (``coordination.Heartbeat``): a raise silences the thread, so a host
   "dies" at a deterministic beat count and its peers' next deadline
   raises a typed ``PeerLost`` naming it.
+- ``"serve.enqueue"`` / ``"serve.predict"`` / ``"serve.reload"`` — the
+  serving subsystem's seams (``serving/``): admission of one request,
+  one replica batch dispatch (the error lands TYPED on every future in
+  the batch — never a hang), and one hot-reload attempt (the engine
+  keeps serving the old params).
 
 Faults are scheduled on the point's CALL COUNT (0-based), so a test kills
 exactly the Nth save or fails exactly the first two rsyncs — no timing, no

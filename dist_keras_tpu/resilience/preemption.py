@@ -168,3 +168,33 @@ def request(signum=signal.SIGTERM):
 def clear():
     global _requested
     _requested = None
+
+
+def on_request(callback, poll_s=0.05):
+    """Invoke ``callback(signum)`` ONCE when a preemption signal lands.
+
+    The signal handler itself must stay lock-free and emit-free (see
+    :func:`_handler`), so consumers that need to *react* — the serving
+    server's graceful drain, a monitor flushing buffers — watch the
+    flag from this daemon thread instead of hooking the handler.  The
+    callback runs on the watcher thread in ordinary thread context
+    (locks, I/O, event emission all fine).  A request already pending
+    fires immediately.  Returns a ``stop()`` callable that cancels the
+    watch (idempotent; a fired watcher stops itself)."""
+    stop = threading.Event()
+
+    def _watch():
+        while not stop.is_set():
+            sig = _requested
+            if sig is not None:
+                try:
+                    callback(sig)
+                finally:
+                    stop.set()
+                return
+            stop.wait(poll_s)
+
+    t = threading.Thread(target=_watch, daemon=True,
+                         name="dk-preempt-watch")
+    t.start()
+    return stop.set

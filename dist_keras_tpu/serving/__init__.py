@@ -1,0 +1,23 @@
+"""Online serving subsystem — dynamic batching, replica scheduling,
+hot checkpoint reload, HTTP front end, graceful drain.
+
+The repo's offline ``ModelPredictor`` and pull-based
+``StreamingPredictor`` answer "run the model over this data"; this
+package answers "keep the model UP for concurrent callers": a
+:class:`ServingEngine` packs requests into a fixed ladder of jitted
+batch shapes across N device replicas, :class:`CheckpointWatcher` rolls
+newly promoted checkpoints in with zero dropped requests, and
+:class:`ServingServer` is the stdlib HTTP boundary with typed
+backpressure and SIGTERM-drain via ``resilience.preemption``.
+
+See the README "Serving" section for endpoints, env knobs and drain
+semantics; ``examples/serving.py`` is the runnable demo;
+``python -m dist_keras_tpu.serving.bench`` the offered-load benchmark.
+"""
+
+from dist_keras_tpu.serving.engine import Overloaded, ServingEngine
+from dist_keras_tpu.serving.reload import CheckpointWatcher
+from dist_keras_tpu.serving.server import ServingServer, default_port
+
+__all__ = ["ServingEngine", "Overloaded", "CheckpointWatcher",
+           "ServingServer", "default_port"]

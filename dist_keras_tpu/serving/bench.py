@@ -1,0 +1,143 @@
+"""CPU-runnable serving benchmark — sustained QPS + latency percentiles.
+
+Offers a FIXED request rate at the engine (a paced scheduler thread
+submits; completion callbacks stamp per-request latency) and reports
+what the engine actually sustained: achieved QPS, p50/p99/max latency,
+rejections, fill ratio, and the retrace bound.  Offered-load (rather
+than closed-loop) measurement is what serving SLOs are written against:
+a closed loop self-throttles to the server's speed and hides queueing
+delay entirely.
+
+Runs anywhere — the model is tiny and ``JAX_PLATFORMS=cpu`` suffices —
+which is the point: ``bench.py`` invokes this in a CPU-pinned
+subprocess, so BENCH rounds report a real serving number even when the
+device backend probe times out (the all-null BENCH failure mode).
+
+CLI: ``python -m dist_keras_tpu.serving.bench [--qps N] [--seconds S]``
+prints one JSON record on the last stdout line (the bench driver
+contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), q))
+
+
+def run_serving_benchmark(offered_qps=400.0, duration_s=4.0,
+                          feature_dim=32, hidden=(64,), num_classes=10,
+                          batch_ladder=(1, 8, 32, 64), replicas=1,
+                          max_latency_s=0.005, max_queue=4096,
+                          warmup=True, seed=0):
+    """Run one offered-load measurement; -> JSON-ready record dict."""
+    # imports deferred so `--help` and a wedged backend never touch jax
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.serving.engine import Overloaded, ServingEngine
+
+    model = mnist_mlp(hidden=tuple(hidden), input_dim=int(feature_dim),
+                      num_classes=int(num_classes))
+    engine = ServingEngine(model, replicas=replicas,
+                           batch_ladder=batch_ladder,
+                           max_latency_s=max_latency_s,
+                           max_queue=max_queue)
+    rng = np.random.default_rng(seed)
+    rows = rng.normal(size=(256, int(feature_dim))).astype(np.float32)
+
+    if warmup:
+        # pre-compile every rung so the measurement window holds zero
+        # compiles (a production engine warms the ladder at deploy time
+        # the same way)
+        for rung in engine.batch_ladder:
+            engine.predict(rows[:rung], timeout_s=120)
+
+    latencies = []
+    lat_lock = threading.Lock()
+    rejected = [0]
+    submitted = [0]
+
+    def _submit_one(i):
+        t0 = time.monotonic()
+
+        def _done(fut):
+            if fut.exception() is None:
+                with lat_lock:
+                    latencies.append(time.monotonic() - t0)
+        try:
+            fut = engine.submit(rows[i % len(rows)])
+        except Overloaded:
+            rejected[0] += 1
+        else:
+            submitted[0] += 1
+            fut.add_done_callback(_done)
+
+    interval = 1.0 / float(offered_qps)
+    t_start = time.monotonic()
+    next_t = t_start
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now - t_start >= duration_s:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        # catch up without sleeping when the scheduler fell behind —
+        # the offered load stays the load, not "what we got around to"
+        _submit_one(i)
+        i += 1
+        next_t += interval
+    # deliver the tail before reading the clocks
+    engine.drain(timeout_s=60)
+    wall = time.monotonic() - t_start
+    stats = engine.stats()
+    record = {
+        "offered_qps": float(offered_qps),
+        "duration_s": round(wall, 3),
+        "submitted": submitted[0],
+        "completed": len(latencies),
+        "rejected": rejected[0],
+        "achieved_qps": round(len(latencies) / wall, 1) if wall else None,
+        "p50_ms": (round(_percentile(latencies, 50) * 1e3, 3)
+                   if latencies else None),
+        "p99_ms": (round(_percentile(latencies, 99) * 1e3, 3)
+                   if latencies else None),
+        "max_ms": (round(max(latencies) * 1e3, 3) if latencies else None),
+        "mean_fill_ratio": (round(stats["fill_ratio"]["mean"], 4)
+                            if stats["fill_ratio"]["mean"] is not None
+                            else None),
+        "batches": stats["batches"],
+        "replicas": stats["replicas"],
+        "batch_ladder": stats["batch_ladder"],
+        "retrace_count": stats["retrace_count"],
+        "retrace_bound": stats["retrace_bound"],
+        "errors": stats["errors"],
+    }
+    return record
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--qps", type=float, default=400.0)
+    ap.add_argument("--seconds", type=float, default=4.0)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--feature-dim", type=int, default=32)
+    args = ap.parse_args(argv)
+    record = run_serving_benchmark(offered_qps=args.qps,
+                                   duration_s=args.seconds,
+                                   replicas=args.replicas,
+                                   feature_dim=args.feature_dim)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,544 @@
+"""Online serving engine — dynamic batching over fixed-shape replicas.
+
+The reference's only online path is the Kafka/Spark-Streaming notebook
+(SURVEY.md §2.4): a pull-based micro-batcher with no concurrency story.
+This engine is the push-based counterpart production TPU serving needs
+(the continuous-batching line of work in PAPERS.md): concurrent callers
+``submit()`` individual feature rows, a dynamic batcher packs them into
+device batches, and N model replicas (one per device, or per device
+group) execute them in parallel.
+
+Design points:
+
+- **Fixed-shape batch ladder.** Requests are packed into the smallest
+  rung of ``batch_ladder`` that fits (padded, pad stripped from the
+  output — the same ``pack_rows`` helper the streaming predictor uses),
+  so a HANDFUL of jitted executables serves all traffic: the number of
+  distinct batch shapes ever dispatched is bounded by the ladder size,
+  and ragged arrival patterns can never trigger unbounded retraces.
+  (Executable count is shapes x replica devices — each device compiles
+  its own copy of each rung, which is inherent, bounded, and counted in
+  :meth:`ServingEngine.stats`.)
+- **Latency-bounded flushes.** A partial batch is flushed after
+  ``max_latency_s`` even when the rung is not full, so a trickle of
+  traffic still gets timely answers; under load the batcher fills the
+  largest rung and the fill ratio approaches 1.
+- **Admission control / backpressure.** Admission is BOUNDED on the
+  count of admitted-but-unresolved requests (``max_queue`` — queued
+  AND batched-in-flight; bounding only the raw queue would let the
+  batcher launder unlimited work into replica inboxes); past the bound
+  a submit rejects with a typed :class:`Overloaded`, so callers (the
+  HTTP front end answers 503) shed load instead of growing an
+  unbounded latency tail.  The same typed rejection covers a
+  draining/stopped engine, so "rejected, not lost" holds at every
+  lifecycle stage.
+- **Hot reload.** :meth:`set_params` atomically swaps each replica's
+  parameters BETWEEN batches (a replica reads its params reference once
+  per batch; a Python reference assignment is atomic under the GIL), so
+  a checkpoint promotion rolls into serving with zero dropped in-flight
+  requests — see ``serving/reload.py`` for the Checkpointer watcher.
+- **Graceful drain.** :meth:`drain` stops admission (typed rejection),
+  flushes every pending request immediately (the latency bound no
+  longer applies), waits for all in-flight batches to deliver, then
+  stops the worker threads.  Nothing admitted is ever dropped.
+- **Typed errors, never hangs.** A failing predict (including the
+  ``"serve.predict"`` fault point) sets the EXCEPTION on every future
+  in that batch — waiters get the error, not a hang.  The
+  ``"serve.enqueue"`` fault point covers admission the same way.
+
+Observability: every seam emits — ``serve_enqueue``,
+``serve_batch_flush`` (with fill ratio), ``serve_predict`` (with
+duration), ``serve_reload``, ``serve_drain`` — and the
+``serve.*`` metrics ride the registry snapshots.  All of it is the
+usual zero-cost no-op when ``DK_OBS_DIR`` is unset.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dist_keras_tpu.data.streaming import pack_rows
+from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.utils.serialization import (
+    deserialize_model,
+    serialize_model,
+)
+
+
+class Overloaded(RuntimeError):
+    """Typed admission rejection — queue full, draining, or stopped.
+
+    ``reason`` is one of ``"queue_full"`` / ``"draining"`` /
+    ``"stopped"``; ``pending`` / ``capacity`` let a front end answer
+    503 with real numbers.  Requests already admitted are unaffected:
+    rejection is strictly at the door, never a drop.
+    """
+
+    def __init__(self, reason, pending=None, capacity=None):
+        self.reason = str(reason)
+        self.pending = pending
+        self.capacity = capacity
+        super().__init__(
+            f"serving engine rejected the request ({self.reason}"
+            + (f"; pending={pending}/{capacity}" if pending is not None
+               else "") + ")")
+
+
+_Request = collections.namedtuple("_Request", ("x", "future", "t"))
+
+
+class _Replica:
+    """One model replica pinned to one device: its params live there and
+    its worker thread runs the shared jitted apply against them.  The
+    ``params`` attribute is the hot-reload swap point (reference
+    assignment; read once per batch)."""
+
+    def __init__(self, index, device, params):
+        self.index = index
+        self.device = device
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
+        self.inbox = queue.Queue()
+        self.batches = 0
+
+    def put_params(self, params):
+        self.params = (jax.device_put(params, self.device)
+                       if self.device is not None else params)
+
+
+class ServingEngine:
+    """Owns the request queue, the dynamic batcher, and N replicas.
+
+    Args:
+      keras_model: any model the serialization layer round-trips (native
+        Sequential / Transformer / Keras-3 JSON) — same contract as
+        ``data.predictors.Predictor``.
+      replicas: number of model replicas.  Default: one per visible
+        device.  Replicas beyond the device count share devices
+        round-robin.
+      batch_ladder: ascending fixed batch shapes; the largest rung is
+        the max batch per dispatch.
+      max_latency_s: flush bound for partial batches.
+      max_queue: admission bound on admitted-but-unresolved requests
+        (queued + batched in flight).
+      devices: explicit device list (default ``jax.devices()``).
+      feature_shape: expected per-row shape, enforced AT THE DOOR
+        (``ValueError``, the front end's 400).  Default None locks to
+        the first admitted row's shape — without this check a public
+        endpoint feeding varying-width rows would compile one
+        executable per width (unbounded retraces) and a ragged pair
+        sharing a batch would fail an innocent neighbour's request.
+    """
+
+    def __init__(self, keras_model, replicas=None,
+                 batch_ladder=(1, 8, 32, 128), max_latency_s=0.01,
+                 max_queue=1024, devices=None, feature_shape=None):
+        self.serialized = serialize_model(keras_model)
+        ladder = sorted(set(int(b) for b in batch_ladder))
+        if not ladder or ladder[0] < 1:
+            raise ValueError(f"batch_ladder {batch_ladder!r} must hold "
+                             "positive ints")
+        self.batch_ladder = tuple(ladder)
+        self.max_batch = ladder[-1]
+        self.max_latency_s = float(max_latency_s)
+        self.max_queue = int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={max_queue} must be >= 1")
+
+        self.feature_shape = (None if feature_shape is None
+                              else tuple(feature_shape))
+        model = deserialize_model(self.serialized)
+        apply_fn = model.apply
+        self._host_params = model.params
+        # one jitted program shared by every replica; the jit cache keys
+        # on (shape, placement), so executables = rungs x devices — both
+        # factors bounded by construction
+        self._apply = jax.jit(lambda p, x: apply_fn(p, x))
+
+        if devices is None:
+            devices = jax.devices()
+        n = int(replicas) if replicas is not None else len(devices)
+        if n < 1:
+            raise ValueError(f"replicas={replicas} must be >= 1")
+        self._replicas = [
+            _Replica(i, devices[i % len(devices)] if devices else None,
+                     self._host_params)
+            for i in range(n)]
+
+        self._cond = threading.Condition()
+        self._pending = collections.deque()
+        self._inflight = 0          # batches dispatched, not yet delivered
+        self._outstanding = 0       # requests admitted, not yet resolved
+        self._draining = False
+        self._stopped = False
+        self._drained = threading.Event()
+        self._rr = 0                # round-robin tiebreaker
+        self._shapes = set()        # rungs actually dispatched (retrace
+        #                             bound: len(_shapes) <= len(ladder))
+        self.reload_count = 0
+
+        # ENGINE-LOCAL instruments (several engines can coexist in one
+        # process — tests, blue/green rollouts — and drain counts must
+        # be per-engine truths) ...
+        self._m_predict = metrics.Histogram("serve.predict_s")
+        self._m_fill = metrics.Histogram("serve.fill_ratio")
+        self._m_wait = metrics.Histogram("serve.queue_wait_s")
+        self._n_enqueued = 0
+        self._n_completed = 0
+        self._n_rejected = 0
+        self._n_errors = 0
+        self._n_batches = 0
+        # ... plus the process-wide registry counters every subsystem
+        # shares (these ride the epoch/periodic snapshots and aggregate
+        # across engines, which is what a process registry means)
+        self._reg_enqueued = metrics.counter("serve.enqueued")
+        self._reg_completed = metrics.counter("serve.completed")
+        self._reg_rejected = metrics.counter("serve.rejected")
+        self._reg_errors = metrics.counter("serve.errors")
+        self._reg_predict = metrics.histogram("serve.predict_s")
+
+        self._replica_threads = [threading.Thread(
+            target=self._replica_loop, args=(rep,), daemon=True,
+            name=f"dk-serve-replica-{rep.index}")
+            for rep in self._replicas]
+        self._batcher_thread = threading.Thread(
+            target=self._batcher_loop, daemon=True, name="dk-serve-batch")
+        for t in self._replica_threads + [self._batcher_thread]:
+            t.start()
+
+    # -- admission ------------------------------------------------------
+    def submit(self, row):
+        """Enqueue one feature row; -> ``concurrent.futures.Future``
+        resolving to the prediction row (or raising the predict error).
+        Raises :class:`Overloaded` at the door — never drops silently."""
+        fault_point("serve.enqueue")
+        x = np.asarray(row, dtype=np.float32)
+        fut = Future()
+        with self._cond:
+            if self._draining or self._stopped:
+                self._n_rejected += 1
+                self._reg_rejected.inc()
+                raise Overloaded(
+                    "draining" if self._draining else "stopped")
+            if self._outstanding >= self.max_queue:
+                self._n_rejected += 1
+                self._reg_rejected.inc()
+                raise Overloaded("queue_full",
+                                 pending=self._outstanding,
+                                 capacity=self.max_queue)
+            # shape check AT THE DOOR (bad request, not backpressure):
+            # it protects the retrace bound AND the neighbours a ragged
+            # row would otherwise drag down inside a shared batch
+            if self.feature_shape is None:
+                self.feature_shape = x.shape
+            elif x.shape != self.feature_shape:
+                raise ValueError(
+                    f"row shape {x.shape} does not match this engine's "
+                    f"feature shape {self.feature_shape} (locked at "
+                    "construction or by the first admitted row)")
+            self._pending.append(_Request(x, fut, time.monotonic()))
+            self._outstanding += 1
+            self._n_enqueued += 1
+            pending = len(self._pending)
+            self._cond.notify()
+        self._reg_enqueued.inc()
+        # NOTE: the subsystem's only per-request event — with DK_OBS_DIR
+        # on it costs one json line per request; the per-batch
+        # serve_batch_flush/serve_predict events carry the load signal
+        events.emit("serve_enqueue", pending=pending)
+        return fut
+
+    def predict(self, rows, timeout_s=None):
+        """Convenience: submit every row, gather results into one
+        (n, ...) array.  Re-raises the first per-row error."""
+        futs = [self.submit(r) for r in rows]
+        return np.stack([f.result(timeout=timeout_s) for f in futs])
+
+    # -- batcher --------------------------------------------------------
+    def _take_batch(self):
+        """Blocking: -> list of requests to pack (<= max rung), or None
+        when the engine stopped with nothing left."""
+        with self._cond:
+            while not self._pending:
+                if self._stopped or self._draining:
+                    return None
+                self._cond.wait()
+            # at least one request: wait up to the latency bound for a
+            # full largest rung — unless draining, which flushes NOW
+            deadline = time.monotonic() + self.max_latency_s
+            while (len(self._pending) < self.max_batch
+                   and not self._draining and not self._stopped):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            take = [self._pending.popleft()
+                    for _ in range(min(len(self._pending),
+                                       self.max_batch))]
+            if take:
+                self._inflight += 1
+            self._cond.notify_all()
+        return take or self._take_batch()
+
+    def _rung_for(self, n):
+        for b in self.batch_ladder:
+            if n <= b:
+                return b
+        return self.max_batch  # n == max_batch by construction
+
+    def _pick_replica(self):
+        """Least-loaded by inbox depth, round-robin on ties."""
+        depths = [r.inbox.qsize() for r in self._replicas]
+        best = min(depths)
+        order = range(self._rr, self._rr + len(self._replicas))
+        for i in order:
+            i %= len(self._replicas)
+            if depths[i] == best:
+                self._rr = (i + 1) % len(self._replicas)
+                return self._replicas[i]
+        return self._replicas[0]  # pragma: no cover - unreachable
+
+    def _batcher_loop(self):
+        while True:
+            take = self._take_batch()
+            if take is None:
+                # draining: keep flushing until the queue is empty, so
+                # every admitted request is delivered before shutdown
+                with self._cond:
+                    if self._pending:
+                        continue
+                    if self._stopped or self._draining:
+                        break
+                    continue  # pragma: no cover - spurious wake
+            try:
+                rung = self._rung_for(len(take))
+                x, n = pack_rows([r.x for r in take], rung)
+            except Exception as e:
+                # a malformed row (ragged shapes across one batch) must
+                # fail ITS OWN requests typed — not kill the batcher
+                # thread and wedge the whole engine behind unresolvable
+                # futures
+                with self._cond:
+                    self._n_errors += len(take)
+                    self._outstanding -= len(take)
+                    self._inflight -= 1
+                    self._cond.notify_all()
+                self._reg_errors.inc(len(take))
+                events.emit("serve_batch_error", n=len(take),
+                            error=type(e).__name__)
+                for r in take:
+                    r.future.set_exception(e)
+                continue
+            now = time.monotonic()
+            with self._cond:  # stats() iterates _shapes under the lock
+                self._shapes.add((rung,) + x.shape[1:])
+            for r in take:
+                self._m_wait.observe(now - r.t)
+            self._m_fill.observe(n / rung)
+            events.emit("serve_batch_flush", rung=rung, n=n,
+                        fill_ratio=n / rung)
+            self._pick_replica().inbox.put((x, take))
+
+    # -- replicas -------------------------------------------------------
+    def _replica_loop(self, rep):
+        while True:
+            item = rep.inbox.get()
+            if item is None:
+                break
+            x, reqs = item
+            t0 = time.perf_counter()
+            try:
+                fault_point("serve.predict")
+                xb = jnp.asarray(x)
+                if rep.device is not None:
+                    xb = jax.device_put(xb, rep.device)
+                preds = np.asarray(self._apply(rep.params, xb))
+            except Exception as e:
+                # typed error to every waiter in the batch — a failed
+                # predict must never hang a caller
+                with self._cond:
+                    self._n_errors += len(reqs)
+                    self._outstanding -= len(reqs)
+                self._reg_errors.inc(len(reqs))
+                events.emit("serve_predict_error", replica=rep.index,
+                            n=len(reqs), error=type(e).__name__)
+                for r in reqs:
+                    r.future.set_exception(e)
+            else:
+                dt = time.perf_counter() - t0
+                rep.batches += 1
+                with self._cond:
+                    self._n_batches += 1
+                    self._n_completed += len(reqs)
+                    self._outstanding -= len(reqs)
+                self._reg_completed.inc(len(reqs))
+                self._m_predict.observe(dt)
+                self._reg_predict.observe(dt)
+                events.emit("serve_predict", replica=rep.index,
+                            n=len(reqs), rung=len(x), duration_s=dt)
+                for r, p in zip(reqs, preds[:len(reqs)]):
+                    r.future.set_result(p)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    # -- hot reload -----------------------------------------------------
+    def set_params(self, state, step=None):
+        """Atomically swap every replica's parameters between batches.
+
+        ``state`` is either a bare params pytree or a training-state
+        dict holding one under ``"params"`` (what ``Checkpointer``
+        snapshots).  In-flight batches finish on the params they
+        started with; the next batch a replica picks up sees the new
+        ones — zero dropped requests, no lock on the predict path."""
+        params = (state["params"]
+                  if isinstance(state, dict) and "params" in state
+                  else state)
+        for rep in self._replicas:
+            rep.put_params(params)
+        self._host_params = params
+        self.reload_count += 1
+        metrics.counter("serve.reloads").inc()
+        events.emit("serve_reload", step=step,
+                    replicas=len(self._replicas))
+
+    # -- lifecycle ------------------------------------------------------
+    def drain(self, timeout_s=None):
+        """Graceful shutdown: stop admission (typed rejection), flush
+        everything pending immediately, deliver every in-flight batch,
+        then stop the workers.  -> dict of delivery counts.  Raises
+        ``TimeoutError`` if the backlog outlives ``timeout_s`` (the
+        workers keep delivering regardless)."""
+        t0 = time.perf_counter()
+        with self._cond:
+            self._draining = True
+            backlog = len(self._pending) + self._inflight
+            self._cond.notify_all()
+        events.emit("serve_drain_begin", backlog=backlog)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._cond:
+            while self._outstanding:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"drain: {self._outstanding} admitted requests "
+                        f"unresolved after {timeout_s}s "
+                        f"({len(self._pending)} queued, "
+                        f"{self._inflight} batches in flight)")
+                self._cond.wait(remaining)
+        # unconditional + idempotent: a PREVIOUS drain that timed out
+        # left _draining set but the workers alive — this call (backlog
+        # now clear) must still be able to stop them
+        self._shutdown_threads()
+        out = {"delivered": self._n_completed,
+               "errored": self._n_errors,
+               "rejected": self._n_rejected,
+               "duration_s": time.perf_counter() - t0}
+        events.emit("serve_drain", **out)
+        return out
+
+    def _shutdown_threads(self):
+        with self._cond:
+            first = not self._stopped
+            self._stopped = True
+            self._cond.notify_all()
+        if not first:  # idempotent: a second caller waits, not re-stops
+            self._drained.wait(timeout=10)
+            return
+        # the BATCHER joins FIRST: it may be between popping a batch and
+        # dispatching it to a replica inbox — a sentinel enqueued before
+        # that dispatch would park the batch behind it forever (replica
+        # loops break on the sentinel), orphaning its futures
+        if self._batcher_thread is not threading.current_thread():
+            self._batcher_thread.join(timeout=10)
+        for rep in self._replicas:
+            rep.inbox.put(None)
+        for t in self._replica_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=10)
+        self._drained.set()
+
+    def close(self, drain=True, timeout_s=None):
+        """Stop the engine.  ``drain=True`` (default) delivers the
+        backlog first; ``drain=False`` fails pending futures with a
+        typed :class:`Overloaded` (still never a silent drop)."""
+        if self._stopped:
+            return
+        if drain:
+            self.drain(timeout_s=timeout_s)
+            return
+        with self._cond:
+            self._draining = True
+            pending, self._pending = list(self._pending), \
+                collections.deque()
+            self._outstanding -= len(pending)
+            self._n_rejected += len(pending)
+            self._cond.notify_all()
+        self._reg_rejected.inc(len(pending))
+        for r in pending:
+            r.future.set_exception(Overloaded("stopped"))
+        self._shutdown_threads()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def running(self):
+        return not self._stopped
+
+    def stats(self):
+        """JSON-ready engine counters — the ``/metricsz`` payload core."""
+        with self._cond:
+            pending, inflight = len(self._pending), self._inflight
+            outstanding = self._outstanding
+            enq, done = self._n_enqueued, self._n_completed
+            rej, err, nb = self._n_rejected, self._n_errors, \
+                self._n_batches
+            shapes = sorted(self._shapes)  # mutated under this lock too
+        return {
+            "replicas": len(self._replicas),
+            "batch_ladder": list(self.batch_ladder),
+            "feature_shape": (list(self.feature_shape)
+                              if self.feature_shape else None),
+            "pending": pending,
+            "outstanding": outstanding,
+            "inflight_batches": inflight,
+            "enqueued": enq,
+            "completed": done,
+            "rejected": rej,
+            "errors": err,
+            "batches": nb,
+            "reloads": self.reload_count,
+            "batches_by_replica": [r.batches for r in self._replicas],
+            "shapes_dispatched": [s[0] for s in shapes],
+            # the no-retrace bound: distinct batch shapes ever dispatched
+            # can never exceed the ladder size (executables on top of
+            # this are shapes x replica devices — also fixed)
+            "retrace_count": len(shapes),
+            "retrace_bound": len(self.batch_ladder),
+            "draining": self._draining,
+            "fill_ratio": self._m_fill.summary(),
+            "predict_s": self._m_predict.summary(),
+            "queue_wait_s": self._m_wait.summary(),
+        }

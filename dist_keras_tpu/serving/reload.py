@@ -1,0 +1,134 @@
+"""Hot model reload — roll promoted checkpoints into a live engine.
+
+A serving fleet must stay up across model updates: the trainer keeps
+promoting new steps through ``checkpoint.Checkpointer`` (whose readers
+only ever see FULLY COMMITTED steps — the two-phase promotion rename is
+the cluster's single publish instant), and this watcher polls
+``latest_step()`` from the serving side, restores any new step, and
+swaps the params into every replica between batches via
+``ServingEngine.set_params`` — zero dropped in-flight requests by the
+engine's swap contract.
+
+Failure semantics (the serving third of the resilience story):
+
+- Restore I/O runs under a named retry policy (``"serve.reload"``
+  surface: transient ``OSError`` absorbed with backoff, events/counters
+  on every attempt).
+- The ``"serve.reload"`` fault point fires per reload attempt, so tests
+  inject a failing reload deterministically.  ``FaultInjected`` is not
+  retryable (a simulated kill stays a kill).
+- A reload that still fails is a TYPED error: :meth:`poll_once` raises
+  it to a direct caller; the background loop records a
+  ``serve_reload_error`` event + ``serve.reload.errors`` counter,
+  keeps serving the OLD params, and keeps watching — a bad checkpoint
+  must never take the fleet down or hang it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.observability.spans import span
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.resilience.retry import RetryPolicy
+
+
+class CheckpointWatcher:
+    """Poll a ``Checkpointer`` for newly promoted steps and hot-swap
+    them into a :class:`~dist_keras_tpu.serving.engine.ServingEngine`.
+
+    Args:
+      engine: the live engine (anything with ``set_params``).
+      checkpointer: ``checkpoint.Checkpointer`` (read-only use: a
+        polling watcher can never interfere with the writer).
+      poll_s: latest-step poll interval for the background loop.
+      template: pytree template for exact orbax restore (defaults to
+        None — fallback-format checkpoints need none).
+      initial_step: steps <= this are considered already served.
+        Default: the latest step at construction, so a fresh watcher
+        only reacts to NEW promotions.
+      on_error: optional callback ``(step, exc)`` from the background
+        loop after a reload fails (already recorded + old params kept).
+    """
+
+    def __init__(self, engine, checkpointer, poll_s=1.0, template=None,
+                 initial_step=None, retry=None, on_error=None):
+        self.engine = engine
+        self.checkpointer = checkpointer
+        self.poll_s = float(poll_s)
+        self.template = template
+        self.on_error = on_error
+        self._retry = retry or RetryPolicy(
+            attempts=3, backoff=0.05, jitter=0.0, retryable=(OSError,),
+            name="serve.reload")
+        self.last_step = (checkpointer.latest_step()
+                          if initial_step is None else int(initial_step))
+        self.reloads = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def poll_once(self):
+        """Check for a newer promoted step; reload it into the engine.
+
+        -> the step reloaded, or None when nothing new.  Raises the
+        (typed) reload error to a direct caller — the background loop
+        is the path that absorbs it."""
+        # timeout_s=0 = a single non-blocking probe of the promoted
+        # steps; the BLOCKING wait stays in wait_for_step_after for
+        # direct callers, while this loop keeps its own stoppable
+        # cadence (self._stop.wait between probes)
+        step = self.checkpointer.wait_for_step_after(
+            step=self.last_step, timeout_s=0)
+        if step is None:
+            return None
+        with span("serve.reload", step=step):
+            def attempt():
+                fault_point("serve.reload")
+                return self.checkpointer.restore(
+                    step=step, template=self.template)
+            _, state = self._retry.call(attempt)
+            self.engine.set_params(state, step=step)
+        self.last_step = step
+        self.reloads += 1
+        return step
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:
+                # typed, recorded, non-fatal: keep serving old params
+                self.errors += 1
+                metrics.counter("serve.reload.errors").inc()
+                events.emit("serve_reload_error",
+                            error=type(e).__name__, detail=str(e)[:200])
+                if self.on_error is not None:
+                    try:
+                        self.on_error(self.checkpointer.latest_step(), e)
+                    except Exception:  # pragma: no cover - user hook
+                        pass
+            self._stop.wait(self.poll_s)
+
+    def start(self):
+        """Start the background watch loop (daemon thread); -> self."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="dk-serve-reload")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

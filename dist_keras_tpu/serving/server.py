@@ -1,0 +1,254 @@
+"""Stdlib-only HTTP front end for the serving engine.
+
+JSON rows in, predictions out — the serving analogue of the reference's
+Kafka topic boundary, but request/response so millions of independent
+clients can call it.  Deliberately ``http.server`` + ``json`` only (the
+image bakes no web framework, and the repo's dependency rule is "gate or
+stub, never install").
+
+Endpoints:
+
+- ``POST /predict`` — body ``{"rows": [[...], ...]}`` (or a bare JSON
+  list of rows); answers ``{"predictions": [[...], ...], "n": N}``.
+  Typed failure mapping: :class:`~.engine.Overloaded` -> **503** (with
+  ``Retry-After``), a per-batch predict error -> **500** naming the
+  error type, a response outliving ``request_timeout_s`` -> **504**,
+  bad JSON -> **400**.  Rejected requests are REJECTED AT THE DOOR —
+  admitted ones are always answered (the engine's no-drop contract).
+- ``GET /healthz`` — **200** ``{"status": "serving"}`` while accepting;
+  **503** ``{"status": "draining"}`` once drain began, so a load
+  balancer stops routing here during the grace window.
+- ``GET /metricsz`` — engine stats + the process metrics registry
+  snapshot, JSON.
+
+Graceful drain rides the EXISTING preemption path
+(``resilience.preemption``): :meth:`ServingServer.install_signal_drain`
+installs the flag-only SIGTERM/SIGINT handler, and a watcher thread
+(``preemption.on_request``) notices the flag, drains the engine (every
+admitted request delivered, new ones 503), and stops the listener.
+:meth:`run_forever` then re-raises :class:`Preempted`, so an uncaught
+drain exits ``128+signum`` — the same scheduler convention trainers and
+bench follow.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from dist_keras_tpu.observability import events
+from dist_keras_tpu.observability import metrics as _metrics
+from dist_keras_tpu.resilience import preemption
+from dist_keras_tpu.serving.engine import Overloaded
+
+
+def default_port(fallback=8000):
+    """The port a launched serving job should bind: ``DK_SERVE_PORT``
+    (exported per host by ``launch.Job(serve_port=...)``), else
+    ``fallback``."""
+    try:
+        return int(os.environ.get("DK_SERVE_PORT", "") or fallback)
+    except ValueError:
+        return fallback
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dk-serve/0.1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: the event log is the log
+        pass
+
+    def _reply(self, code, payload, retry_after=None):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self.server
+        if self.path.split("?")[0] == "/healthz":
+            if srv.engine.draining or not srv.engine.running:
+                self._reply(503, {"status": "draining"})
+            else:
+                st = srv.engine.stats()
+                self._reply(200, {"status": "serving",
+                                  "replicas": st["replicas"],
+                                  "pending": st["pending"]})
+        elif self.path.split("?")[0] == "/metricsz":
+            self._reply(200, {"engine": srv.engine.stats(),
+                              "registry": _metrics.snapshot()})
+        else:
+            self._reply(404, {"error": "not_found", "path": self.path})
+
+    def do_POST(self):
+        srv = self.server
+        if self.path.split("?")[0] != "/predict":
+            self._reply(404, {"error": "not_found", "path": self.path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n).decode("utf-8"))
+            rows = doc["rows"] if isinstance(doc, dict) else doc
+            rows = [np.asarray(r, dtype=np.float32) for r in rows]
+            if not rows:
+                raise ValueError("empty rows")
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad_request",
+                              "detail": str(e)[:200]})
+            return
+        try:
+            futs = [srv.engine.submit(r) for r in rows]
+        except Overloaded as e:
+            # the engine's typed backpressure -> LB-visible 503; rows
+            # admitted before the rejection still complete inside the
+            # engine (rejected-not-lost), the caller just retries whole
+            self._reply(503, {"error": "overloaded", "reason": e.reason,
+                              "pending": e.pending,
+                              "capacity": e.capacity}, retry_after=1)
+            return
+        except ValueError as e:  # row shape mismatch: the CALLER's bug
+            self._reply(400, {"error": "bad_request",
+                              "detail": str(e)[:200]})
+            return
+        except Exception as e:  # typed admission error (enqueue fault)
+            self._reply(500, {"error": type(e).__name__,
+                              "detail": str(e)[:200]})
+            return
+        try:
+            deadline = time.monotonic() + srv.request_timeout_s
+            preds = [f.result(timeout=max(0.0,
+                                          deadline - time.monotonic()))
+                     for f in futs]
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # (distinct classes before py3.11, one alias after)
+            self._reply(504, {"error": "timeout",
+                              "timeout_s": srv.request_timeout_s})
+            return
+        except Exception as e:  # typed predict error (fault, OOM, ...)
+            self._reply(500, {"error": type(e).__name__,
+                              "detail": str(e)[:200]})
+            return
+        self._reply(200, {
+            "predictions": [np.asarray(p).tolist() for p in preds],
+            "n": len(preds)})
+
+
+class ServingServer(ThreadingHTTPServer):
+    """Threaded HTTP server wrapping one :class:`ServingEngine`.
+
+    ``port=None`` binds :func:`default_port` (the ``DK_SERVE_PORT``
+    launch export); ``port=0`` picks a free one (tests).
+    """
+
+    daemon_threads = True
+
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 request_timeout_s=30.0):
+        self.engine = engine
+        self.request_timeout_s = float(request_timeout_s)
+        self.preempted_signum = None
+        self._stop_watch = None
+        self._thread = None
+        # lifecycle guard: BaseServer.shutdown() BLOCKS FOREVER unless
+        # serve_forever is actually running (it waits on an event only
+        # serve_forever's exit sets) — drain()/close() on a constructed-
+        # but-never-started server must not wedge the calling thread
+        self._lifecycle = threading.Lock()
+        self._serving = False
+        self._stopping = False
+        if port is None:
+            port = default_port(fallback=0)
+        super().__init__((host, int(port)), _Handler)
+
+    @property
+    def address(self):
+        """(host, bound_port) — port resolved after bind."""
+        return self.server_address[:2]
+
+    # -- lifecycle -----------------------------------------------------
+    def serve_forever(self, poll_interval=0.5):
+        with self._lifecycle:
+            if self._stopping:
+                return  # a drain/close already won the race: stay down
+            self._serving = True
+        try:
+            super().serve_forever(poll_interval)
+        finally:
+            with self._lifecycle:
+                self._serving = False
+
+    def _stop_listener(self):
+        """Stop the accept loop (only if it ever started) and close the
+        socket — safe from any thread, any lifecycle state."""
+        with self._lifecycle:
+            self._stopping = True
+            serving = self._serving
+        if serving:
+            self.shutdown()
+        self.server_close()
+
+    def start(self):
+        """Serve on a background thread (tests / notebook use);
+        -> (host, port)."""
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="dk-serve-http")
+        self._thread.start()
+        events.emit("serve_listen", host=self.address[0],
+                    port=self.address[1])
+        return self.address
+
+    def install_signal_drain(self, poll_s=0.05):
+        """Wire SIGTERM/SIGINT -> graceful drain through the existing
+        ``resilience.preemption`` path: the signal handler only sets a
+        flag (async-signal-safe); a watcher thread notices it and runs
+        the drain.  Off the main thread this degrades (``strict=False``)
+        to watching flags set via ``preemption.request`` only.  -> True
+        when the real handlers installed."""
+        installed = preemption.install(strict=False)
+        self._stop_watch = preemption.on_request(self._drain_on_signal,
+                                                 poll_s=poll_s)
+        return installed
+
+    def _drain_on_signal(self, signum):
+        self.preempted_signum = signum
+        events.emit("serve_drain_signal", signum=signum)
+        self.drain()
+
+    def drain(self, timeout_s=None):
+        """Stop admission, deliver every in-flight request, stop the
+        listener.  Idempotent; while the backlog drains, /healthz and
+        /predict answer typed 503s; once drained the listening socket
+        CLOSES — late clients get connection-refused (a fast typed
+        failure), never a connection parked in an unserviced backlog."""
+        out = self.engine.drain(timeout_s=timeout_s)
+        self._stop_listener()  # in-flight handler threads still finish
+        return out
+
+    def run_forever(self):
+        """Serve on the CALLING thread until stopped.  After a
+        signal-initiated drain, re-raises :class:`Preempted` so the
+        process exits ``128+signum`` (scheduler convention)."""
+        try:
+            self.serve_forever()
+        finally:
+            self.server_close()
+        if self.preempted_signum is not None:
+            raise preemption.Preempted(self.preempted_signum)
+
+    def close(self):
+        if self._stop_watch is not None:
+            self._stop_watch()
+        self._stop_listener()
+        if self.engine.running:
+            self.engine.close()

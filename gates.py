@@ -38,8 +38,20 @@ emission costs <5% wall-clock versus the ``DK_OBS_DIR``-unset run
 (min-of-3 train timings inside each worker, so process start/compile
 noise stays out of the comparison).
 
+The SERVING gate (``--serving-only``) runs two CPU subprocess
+scenarios: a load worker (the engine must sustain a fixed offered QPS
+with bounded p99 and zero drops, hot-reload a Checkpointer promotion
+mid-load with zero dropped in-flight requests, surface each
+``serve.*`` fault as a typed error — never a hang — and keep its
+batch-shape retrace count within the ladder) and a drain worker (a
+live HTTP server under background load receives a REAL SIGTERM from
+the gate, drains through the preemption path with every admitted
+request delivered, rejects afterwards with a typed ``Overloaded``,
+and exits 143).
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
+                        [--serving-only]
 """
 
 from __future__ import annotations
@@ -218,6 +230,264 @@ for i in range(6):
 print("NOT_PREEMPTED", rank, flush=True)
 sys.exit(1)
 """
+
+
+# The serving gate's worker (two modes, one subprocess each):
+#
+# "load"  — (1) offered-load benchmark: the engine must SUSTAIN the
+#           offered QPS (>= 90%) with bounded p99 and zero
+#           rejected/dropped requests; (2) a mid-load hot reload from a
+#           real Checkpointer promotion with zero dropped in-flight
+#           requests and actually-swapped params; (3) each ``serve.*``
+#           fault point fires as a TYPED error — the enqueue fault at
+#           the door, the predict fault on the waiter's future, the
+#           reload fault from poll_once — never a hang, and the engine
+#           keeps serving afterwards; (4) the batcher's retrace count
+#           stays <= the batch-shape ladder size.
+# "drain" — a real HTTP server under background load; the PARENT sends
+#           SIGTERM; the preemption-path drain must deliver every
+#           admitted request (delivered == submitted, zero errors),
+#           reject post-drain admission with a typed Overloaded
+#           (rejected-not-lost), and exit 128+SIGTERM.
+_SERVE_WORKER = r"""
+import os, sys, json, time, threading
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.resilience import faults
+from dist_keras_tpu.resilience.faults import FaultInjected
+from dist_keras_tpu.serving import (
+    CheckpointWatcher, Overloaded, ServingEngine, ServingServer)
+from dist_keras_tpu.serving.bench import run_serving_benchmark
+
+mode, work = sys.argv[1], sys.argv[2]
+failures = []
+
+def check(cond, msg):
+    if not cond:
+        failures.append(msg)
+
+if mode == "load":
+    rec = run_serving_benchmark(offered_qps=300.0, duration_s=3.0)
+    check(rec["rejected"] == 0, f"rejected under moderate load: {rec}")
+    check(rec["completed"] == rec["submitted"],
+          f"dropped requests: {rec}")
+    check(rec["achieved_qps"] >= 0.9 * rec["offered_qps"],
+          f"did not sustain offered load: {rec}")
+    check(rec["p99_ms"] is not None and rec["p99_ms"] < 250.0,
+          f"p99 unbounded: {rec}")
+    check(rec["retrace_count"] <= rec["retrace_bound"],
+          f"retraces exceed the ladder: {rec}")
+
+    model = mnist_mlp(hidden=(16,), input_dim=8, num_classes=3)
+    eng = ServingEngine(model, replicas=2, batch_ladder=(1, 8, 32),
+                        max_latency_s=0.002, max_queue=4096)
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(64, 8)).astype(np.float32)
+    for r in (1, 8, 32):
+        eng.predict(rows[:r], timeout_s=120)  # warm the ladder
+    base = eng.predict(rows[:4], timeout_s=60)
+    ck = Checkpointer(os.path.join(work, "ck"), max_to_keep=3)
+    ck.save(1, {"params": jax.tree.map(
+        lambda a: np.asarray(a) * 0.5, model.params)})
+    watcher = CheckpointWatcher(eng, ck, poll_s=0.02,
+                                initial_step=0).start()
+    futs, n_sub = [], 0
+    t_end = time.monotonic() + 1.5
+    while time.monotonic() < t_end:
+        futs.append(eng.submit(rows[n_sub % len(rows)]))
+        n_sub += 1
+        time.sleep(0.001)
+    done = [f.result(timeout=60) for f in futs]
+    check(len(done) == n_sub, "reload dropped in-flight requests")
+    check(watcher.reloads >= 1,
+          f"hot reload never happened ({watcher.reloads})")
+    after = eng.predict(rows[:4], timeout_s=60)
+    check(not np.allclose(after, base), "params did not swap")
+    watcher.stop()
+
+    with faults.armed("serve.enqueue"):
+        try:
+            eng.submit(rows[0])
+            check(False, "serve.enqueue fault did not fire")
+        except FaultInjected:
+            pass
+    with faults.armed("serve.predict"):
+        fut = eng.submit(rows[0])
+        try:
+            fut.result(timeout=30)
+            check(False, "serve.predict fault did not surface")
+        except FaultInjected:
+            pass
+    ck.save(2, {"params": model.params})
+    w2 = CheckpointWatcher(eng, ck, poll_s=0.02, initial_step=1)
+    with faults.armed("serve.reload"):
+        try:
+            w2.poll_once()
+            check(False, "serve.reload fault did not fire")
+        except FaultInjected:
+            pass
+    ok = eng.predict(rows[:4], timeout_s=60)
+    check(ok.shape == (4, 3), "engine dead after faults")
+    st = eng.stats()
+    check(st["retrace_count"] <= st["retrace_bound"],
+          f"retrace bound violated: {st}")
+    eng.drain(timeout_s=60)
+    print("SERVE_RESULT " + json.dumps(
+        {"ok": not failures, "failures": failures, "bench": rec}),
+        flush=True)
+    sys.exit(0 if not failures else 1)
+
+# mode == "drain"
+model = mnist_mlp(hidden=(16,), input_dim=8, num_classes=3)
+eng = ServingEngine(model, replicas=1, batch_ladder=(1, 8, 32),
+                    max_latency_s=0.005, max_queue=4096)
+rng = np.random.default_rng(0)
+rows = rng.normal(size=(64, 8)).astype(np.float32)
+for r in (1, 8, 32):
+    eng.predict(rows[:r], timeout_s=120)
+srv = ServingServer(eng, port=0)
+srv.start()
+srv.install_signal_drain(poll_s=0.02)
+counts = {"submitted": 0, "delivered": 0, "errors": 0}
+stop_load = threading.Event()
+
+def load():
+    futs = []
+    while not stop_load.is_set():
+        try:
+            futs.append(eng.submit(rows[counts["submitted"] % 64]))
+            counts["submitted"] += 1
+        except Overloaded:
+            break  # draining: admission closed, typed
+        time.sleep(0.0005)
+    for f in futs:
+        try:
+            f.result(timeout=60)
+            counts["delivered"] += 1
+        except Exception:
+            counts["errors"] += 1
+
+loader = threading.Thread(target=load)
+loader.start()
+with open(os.path.join(work, "ready"), "w") as f:
+    f.write(str(os.getpid()))
+try:
+    # parent sends SIGTERM; preemption watcher drains; Preempted raises
+    while srv.preempted_signum is None:
+        time.sleep(0.05)
+    loader.join(timeout=60)
+    stop_load.set()
+    ok = (counts["delivered"] == counts["submitted"]
+          and counts["errors"] == 0 and counts["submitted"] > 0)
+    try:
+        eng.submit(rows[0])
+        ok, reason = False, "post-drain submit accepted"
+    except Overloaded as ex:
+        reason = ex.reason
+    print("DRAIN_RESULT " + json.dumps(
+        {"ok": ok, "reason": reason, **counts}), flush=True)
+finally:
+    stop_load.set()
+from dist_keras_tpu.resilience.preemption import Preempted
+raise Preempted(srv.preempted_signum)
+"""
+
+
+def run_serving_gate(timeout=420):
+    """-> gate record for the serving subsystem (see _SERVE_WORKER)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_serve_gate_")
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_SERVE_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_SERVE"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    bench_rec = None
+    t0 = time.time()
+    try:
+        # scenario 1: sustained load + hot reload + serve.* faults
+        p = subprocess.Popen([sys.executable, script, "load", work],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT,
+                             env=base_env, text=True)
+        try:
+            out = p.communicate(timeout=timeout)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = p.communicate()[0]
+            failures.append(f"load: HANG (killed at {timeout}s)")
+        m = re.search(r"^SERVE_RESULT (\{.*\})$", out, re.M)
+        if m:
+            doc = json.loads(m.group(1))
+            bench_rec = doc.get("bench")
+            failures.extend("load: " + f for f in doc.get("failures", []))
+            if p.returncode != 0 and not doc.get("failures"):
+                failures.append(f"load: rc={p.returncode}")
+        elif not failures:
+            failures.append(f"load: no SERVE_RESULT "
+                            f"(rc={p.returncode}): {out[-300:]}")
+
+        # scenario 2: SIGTERM -> graceful drain, zero dropped, 143
+        p = subprocess.Popen([sys.executable, script, "drain", work],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT,
+                             env=base_env, text=True)
+        ready = os.path.join(work, "ready")
+        t_wait = time.time()
+        while not os.path.exists(ready) and p.poll() is None \
+                and time.time() - t_wait < timeout:
+            time.sleep(0.05)
+        if not os.path.exists(ready):
+            p.kill()
+            out = p.communicate()[0]
+            failures.append(f"drain: worker never became ready: "
+                            f"{out[-300:]}")
+        else:
+            time.sleep(0.7)  # let the background load run
+            p.send_signal(_signal.SIGTERM)
+            try:
+                out = p.communicate(timeout=timeout)[0]
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = p.communicate()[0]
+                failures.append(f"drain: HANG after SIGTERM "
+                                f"(killed at {timeout}s)")
+            if p.returncode != 143 and "HANG" not in str(failures):
+                failures.append(f"drain: rc={p.returncode} (want 143): "
+                                f"{out[-300:]}")
+            m = re.search(r"^DRAIN_RESULT (\{.*\})$", out, re.M)
+            if m:
+                doc = json.loads(m.group(1))
+                if not doc.get("ok"):
+                    failures.append(f"drain: dropped/failed: {doc}")
+            else:
+                failures.append(f"drain: no DRAIN_RESULT: {out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "serving",
+        "metric": "sustained_qps_reload_drain_faults",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "bench": bench_rec,
+        "failures": failures,
+    }
 
 
 def _run_obs_pair(script, base_env, work, name, obs_dir, timeout):
@@ -471,7 +741,16 @@ def main():
                     help="run just the observability gate (merged-"
                          "report completeness + <5%% emission "
                          "overhead) and print its record")
+    ap.add_argument("--serving-only", action="store_true",
+                    help="run just the serving gate (sustained QPS, "
+                         "hot reload, SIGTERM drain, serve.* faults, "
+                         "retrace bound) and print its record")
     args = ap.parse_args()
+
+    if args.serving_only:
+        serve_gate = run_serving_gate()
+        print(json.dumps(serve_gate, indent=1))
+        return 0 if serve_gate["passed"] else 1
 
     if args.obs_only:
         obs_gate = run_obs_gate()
@@ -486,6 +765,7 @@ def main():
     res = run_gates(fast=args.fast)
     res["gates"].append(coord_gate)
     res["gates"].append(run_obs_gate())
+    res["gates"].append(run_serving_gate())
     import platform
 
     doc = {

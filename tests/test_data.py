@@ -186,3 +186,64 @@ def test_from_spark_all_null_column_raises():
 
     with pytest.raises(ValueError, match="'feats'"):
         Dataset.from_spark(NullSDF())
+
+
+# -- ModelPredictor edge cases (round 9) ------------------------------
+def _trained_free_model(input_dim=6, classes=3):
+    from dist_keras_tpu.models import mnist_mlp
+
+    return mnist_mlp(hidden=(8,), input_dim=input_dim,
+                     num_classes=classes)
+
+
+def test_model_predictor_empty_dataset():
+    from dist_keras_tpu.data import Dataset, ModelPredictor
+
+    model = _trained_free_model()
+    ds = Dataset({"features": np.zeros((0, 6), dtype=np.float32),
+                  "label": np.zeros((0,), dtype=np.int64)})
+    for sharded in (False, True):
+        out = ModelPredictor(model, sharded=sharded).predict(ds)
+        pred = out["prediction"]
+        # empty but carrying the model's REAL output shape, so
+        # downstream evaluators/concats keep working
+        assert pred.shape == (0, 3)
+        assert len(out) == 0
+
+
+def test_model_predictor_fewer_rows_than_shards():
+    import jax
+
+    from dist_keras_tpu.data import Dataset, ModelPredictor
+
+    model = _trained_free_model()
+    n_dev = len(jax.devices())
+    assert n_dev > 1, "conftest pins an 8-virtual-device CPU mesh"
+    n = n_dev - 1  # fewer rows than devices: pad must fill the shard
+    x = np.random.default_rng(0).normal(size=(n, 6)).astype(np.float32)
+    ds = Dataset({"features": x, "label": np.zeros(n, dtype=np.int64)})
+    got = ModelPredictor(model, sharded=True).predict(ds)["prediction"]
+    want = np.asarray(model.apply(model.params, x))
+    assert got.shape == (n, 3)
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_model_predictor_pad_strip_correctness_sharded():
+    from dist_keras_tpu.data import Dataset, ModelPredictor
+
+    model = _trained_free_model()
+    # n deliberately NOT divisible by the device-rounded batch: the
+    # final batch is padded (last row replicated) and the pad must be
+    # stripped exactly — no phantom rows, no truncation
+    n = 37
+    x = np.random.default_rng(1).normal(size=(n, 6)).astype(np.float32)
+    ds = Dataset({"features": x, "label": np.zeros(n, dtype=np.int64)})
+    got = ModelPredictor(model, batch_size=16,
+                         sharded=True).predict(ds)["prediction"]
+    want = np.asarray(model.apply(model.params, x))
+    assert got.shape == (n, 3)
+    assert np.allclose(got, want, atol=1e-5)
+    # unsharded path agrees with the sharded one on the same rows
+    got1 = ModelPredictor(model, batch_size=16,
+                          sharded=False).predict(ds)["prediction"]
+    assert np.allclose(got, got1, atol=1e-5)

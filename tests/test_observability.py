@@ -517,3 +517,82 @@ def test_barrier_default_timeout_env(monkeypatch):
     assert backend.barrier_default_timeout_s() == 33.5
     monkeypatch.setenv("DK_COORD_TIMEOUT_S", "junk")
     assert backend.barrier_default_timeout_s() == 120.0
+
+
+# -- event-file rotation (round 9: DK_OBS_ROTATE_MB) ------------------
+def test_rotation_caps_file_size_and_keeps_segments(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("DK_OBS_ROTATE_KEEP", "2")
+    # tiny cap so a handful of events rotates: 300 bytes
+    w = events.EventWriter(str(tmp_path), rank=0, rotate_bytes=300,
+                           rotate_keep=2)
+    for i in range(40):
+        w.emit("tick", i=i, pad="x" * 40)
+    w.close()
+    names = sorted(os.listdir(tmp_path))
+    assert "events-rank_0.jsonl" in names
+    assert "events-rank_0.jsonl.1" in names
+    # keep=2 bounds the rotated segments — no .3 ever
+    assert not any(n.endswith(".3") for n in names)
+    for n in names:
+        assert os.path.getsize(tmp_path / n) <= 300 + 120  # cap + 1 line
+
+
+def test_rotation_report_merges_segments_in_order(tmp_path):
+    w = events.EventWriter(str(tmp_path), rank=0, rotate_bytes=200,
+                           rotate_keep=5)
+    total = 25
+    for i in range(total):
+        w.emit("tick", i=i)
+    w.close()
+    assert any(".jsonl." in n for n in os.listdir(tmp_path)), \
+        "cap never triggered — shrink the test cap"
+    evs = report.read_events(tmp_path)
+    # every retained segment merges into ONE timeline, ordered by
+    # (t, rank, seq): seq stays monotonic across rotations
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    assert [e["i"] for e in evs] == list(range(total))[-len(evs):] \
+        or len(evs) == total
+
+
+def test_rotation_env_knob_and_disabled_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_OBS_ROTATE_MB", "0.0002")  # ~210 bytes
+    w = events.EventWriter(str(tmp_path / "a"), rank=1)
+    assert w.rotate_bytes == int(0.0002 * 2**20)
+    for i in range(20):
+        w.emit("tick", i=i)
+    w.close()
+    assert any(".jsonl." in n for n in os.listdir(tmp_path / "a"))
+    monkeypatch.delenv("DK_OBS_ROTATE_MB")
+    w2 = events.EventWriter(str(tmp_path / "b"), rank=1)
+    assert w2.rotate_bytes == 0  # unset = unbounded (old behaviour)
+    w2.close()
+    monkeypatch.setenv("DK_OBS_ROTATE_MB", "garbage")
+    w3 = events.EventWriter(str(tmp_path / "c"), rank=1)
+    assert w3.rotate_bytes == 0  # malformed knob never kills the run
+    w3.emit("tick")
+    w3.close()
+
+
+# -- Job.monitor + serve_port (round 9 satellites) --------------------
+def test_job_monitor_prints_rank_transitions(tmp_path):
+    from dist_keras_tpu.launch.job import Job
+
+    jobdir = tmp_path / "job"
+    jobdir.mkdir()
+    obs = tmp_path / "obs"
+    w = events.EventWriter(str(obs), rank=0)
+    w.emit("train_start")
+    w.close()
+    w = events.EventWriter(str(obs), rank=1)
+    w.emit("train_start")
+    w.emit("epoch_end", epoch=0)
+    w.close()
+    job = Job("s", "mon2", str(jobdir), hosts=["h0", "h1"],
+              dry_run=True, obs_dir=str(obs))
+    printed = []
+    lines = job.monitor(interval_s=0.01, max_polls=1,
+                        out=printed.append)
+    assert printed == lines
+    assert any("rank 0" in ln for ln in lines)
+    assert any("rank 1" in ln and "epoch_end" in ln for ln in lines)

@@ -1,0 +1,550 @@
+"""Serving subsystem: engine batching/backpressure, hot reload, HTTP
+front end, graceful drain, serve.* faults, and the launch/monitor
+satellites."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.launch.job import Job
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.observability import events as obs_events
+from dist_keras_tpu.resilience import faults, preemption
+from dist_keras_tpu.resilience.faults import FaultInjected
+from dist_keras_tpu.serving import (
+    CheckpointWatcher,
+    Overloaded,
+    ServingEngine,
+    ServingServer,
+    default_port,
+)
+from dist_keras_tpu.serving.bench import run_serving_benchmark
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _model():
+    return mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, 4)) \
+        .astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def engine_and_model():
+    m = _model()
+    eng = ServingEngine(m, replicas=2, batch_ladder=(1, 4, 16),
+                        max_latency_s=0.005, max_queue=256)
+    yield eng, m
+    if eng.running:
+        eng.close()
+
+
+# -- engine ------------------------------------------------------------
+def test_engine_parity_with_direct_apply(engine_and_model):
+    eng, m = engine_and_model
+    rows = _rows(23)
+    preds = eng.predict(rows, timeout_s=120)
+    want = np.asarray(m.apply(m.params, rows))
+    assert preds.shape == want.shape
+    assert np.allclose(preds, want, atol=1e-5)
+
+
+def test_engine_ladder_bounds_shapes(engine_and_model):
+    eng, _ = engine_and_model
+    for n in (1, 2, 3, 5, 9, 16, 7, 4):
+        eng.predict(_rows(n, seed=n), timeout_s=120)
+    st = eng.stats()
+    assert st["retrace_count"] <= st["retrace_bound"] == 3
+    assert set(st["shapes_dispatched"]) <= {1, 4, 16}
+
+
+def test_engine_single_row_flushes_within_latency(engine_and_model):
+    eng, _ = engine_and_model
+    t0 = time.monotonic()
+    fut = eng.submit(_rows(1)[0])
+    fut.result(timeout=120)
+    # generous CI bound: flush bound is 5ms, a warm predict ~1ms
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_engine_oversized_predict_splits_across_batches(engine_and_model):
+    eng, m = engine_and_model
+    rows = _rows(50)  # > max rung 16: spans multiple dispatches
+    preds = eng.predict(rows, timeout_s=120)
+    assert np.allclose(preds, np.asarray(m.apply(m.params, rows)),
+                       atol=1e-5)
+
+
+def test_engine_overload_typed_rejection():
+    m = _model()
+    # a 1-deep queue with a predict gate held shut: the 2nd..Nth
+    # submits must reject with the typed Overloaded, not block or drop
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1,),
+                        max_latency_s=10.0, max_queue=1)
+    try:
+        gate = threading.Event()
+        orig = eng._apply
+
+        def slow_apply(p, x):
+            gate.wait(30)
+            return orig(p, x)
+
+        eng._apply = slow_apply
+        futs = [eng.submit(_rows(1)[0])]
+        # one may slip into the batcher; the queue bound rejects beyond
+        rejected = 0
+        for _ in range(8):
+            try:
+                futs.append(eng.submit(_rows(1)[0]))
+            except Overloaded as e:
+                rejected += 1
+                assert e.reason == "queue_full"
+                assert e.capacity == 1
+        assert rejected >= 6
+        gate.set()
+        for f in futs:
+            f.result(timeout=120)  # admitted ones all deliver
+    finally:
+        gate.set()
+        eng.close()
+    st = eng.stats()
+    assert st["completed"] == len(futs)
+    assert st["rejected"] == rejected
+
+
+def test_engine_drain_delivers_everything_then_rejects():
+    m = _model()
+    eng = ServingEngine(m, replicas=2, batch_ladder=(1, 8),
+                        max_latency_s=0.002, max_queue=512)
+    futs = [eng.submit(r) for r in _rows(40)]
+    out = eng.drain(timeout_s=120)
+    assert all(f.done() for f in futs)
+    assert out["delivered"] == 40 and out["errored"] == 0
+    with pytest.raises(Overloaded) as ei:
+        eng.submit(_rows(1)[0])
+    assert ei.value.reason == "draining"
+    assert not eng.running
+
+
+def test_engine_close_without_drain_fails_pending_typed():
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(4,),
+                        max_latency_s=30.0, max_queue=64)
+    # latency bound far out + partial rung: requests sit in the queue
+    futs = [eng.submit(r) for r in _rows(2)]
+    eng.close(drain=False)
+    for f in futs:
+        if f.done() and f.exception() is None:
+            continue  # raced into the batcher before the cut — delivered
+        with pytest.raises(Overloaded):
+            f.result(timeout=10)
+
+
+def test_engine_hot_swap_zero_dropped():
+    m = _model()
+    eng = ServingEngine(m, replicas=2, batch_ladder=(1, 8),
+                        max_latency_s=0.001, max_queue=4096)
+    try:
+        rows = _rows(16)
+        base = eng.predict(rows[:4], timeout_s=120)
+        futs = []
+        for i in range(300):
+            futs.append(eng.submit(rows[i % 16]))
+            if i == 150:
+                eng.set_params(jax.tree.map(lambda a: a * 0.5, m.params))
+        res = [f.result(timeout=120) for f in futs]
+        assert len(res) == 300  # zero dropped across the swap
+        after = eng.predict(rows[:4], timeout_s=120)
+        assert not np.allclose(after, base)
+        assert eng.reload_count == 1
+        # accepts a full training-state dict too
+        eng.set_params({"params": m.params, "epoch": 3})
+        again = eng.predict(rows[:4], timeout_s=120)
+        assert np.allclose(again, base, atol=1e-5)
+    finally:
+        eng.close()
+
+
+def test_engine_fault_enqueue_and_predict_typed(engine_and_model):
+    eng, _ = engine_and_model
+    with faults.armed("serve.enqueue"):
+        with pytest.raises(FaultInjected):
+            eng.submit(_rows(1)[0])
+    with faults.armed("serve.predict"):
+        fut = eng.submit(_rows(1)[0])
+        with pytest.raises(FaultInjected):
+            fut.result(timeout=60)  # typed on the future, never a hang
+    # engine survives both
+    assert eng.predict(_rows(3), timeout_s=120).shape == (3, 3)
+
+
+def test_engine_bad_args():
+    with pytest.raises(ValueError):
+        ServingEngine(_model(), batch_ladder=())
+    with pytest.raises(ValueError):
+        ServingEngine(_model(), batch_ladder=(0, 4))
+    with pytest.raises(ValueError):
+        ServingEngine(_model(), max_queue=0)
+    with pytest.raises(ValueError):
+        ServingEngine(_model(), replicas=0)
+
+
+def test_engine_emits_events(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_OBS_DIR", str(tmp_path))
+    obs_events.reset()
+    try:
+        m = _model()
+        eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                            max_latency_s=0.002)
+        eng.predict(_rows(6), timeout_s=120)
+        eng.set_params(m.params)
+        eng.drain(timeout_s=60)
+    finally:
+        obs_events.reset()
+        monkeypatch.delenv("DK_OBS_DIR")
+    kinds = set()
+    with open(tmp_path / "events-rank_0.jsonl") as f:
+        for line in f:
+            kinds.add(json.loads(line)["kind"])
+    for want in ("serve_enqueue", "serve_batch_flush", "serve_predict",
+                 "serve_reload", "serve_drain_begin", "serve_drain"):
+        assert want in kinds, (want, kinds)
+    obs_events.reset()
+
+
+# -- hot reload from a Checkpointer -----------------------------------
+def test_checkpoint_watcher_reloads_promoted_steps(tmp_path):
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        base = eng.predict(_rows(4), timeout_s=120)
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+        w = CheckpointWatcher(eng, ck, poll_s=0.5)
+        assert w.poll_once() is None  # nothing promoted yet
+        ck.save(1, {"params": jax.tree.map(
+            lambda a: np.asarray(a) * 0.25, m.params)})
+        assert w.poll_once() == 1
+        assert w.last_step == 1 and w.reloads == 1
+        after = eng.predict(_rows(4), timeout_s=120)
+        assert not np.allclose(after, base)
+        assert w.poll_once() is None  # same step: no re-reload
+        # an OLDER step appearing (retention races) is ignored
+        ck.save(0, {"params": m.params})
+        assert w.poll_once() is None
+    finally:
+        eng.close()
+
+
+def test_checkpoint_watcher_background_loop_and_fault(tmp_path):
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
+        seen = []
+        w = CheckpointWatcher(eng, ck, poll_s=0.02,
+                              on_error=lambda s, e: seen.append(e))
+        with w:  # context manager starts/stops the loop
+            ck.save(1, {"params": m.params})
+            deadline = time.monotonic() + 20
+            while w.reloads < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert w.reloads == 1
+            # a failing reload is typed + non-fatal: old params kept,
+            # loop keeps watching and picks up the NEXT good step
+            faults.inject("serve.reload")
+            ck.save(2, {"params": m.params})
+            deadline = time.monotonic() + 20
+            # wait on the CALLBACK, not w.errors: errors increments a
+            # beat before on_error appends, and seen[0] must exist
+            while not seen and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert w.errors >= 1
+            assert seen and isinstance(seen[0], FaultInjected)
+            deadline = time.monotonic() + 20
+            while w.last_step != 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert w.last_step == 2  # recovered on the next poll
+        assert eng.predict(_rows(2), timeout_s=120).shape == (2, 3)
+    finally:
+        eng.close()
+
+
+def test_checkpointer_wait_for_step_after(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    assert ck.wait_for_step_after(timeout_s=0.05, poll_s=0.01) is None
+    ck.save(3, {"x": np.ones(2)})
+    assert ck.wait_for_step_after(timeout_s=5, poll_s=0.01) == 3
+    assert ck.wait_for_step_after(step=3, timeout_s=0.05,
+                                  poll_s=0.01) is None
+
+    def later():
+        time.sleep(0.1)
+        ck.save(4, {"x": np.ones(2)})
+
+    t = threading.Thread(target=later)
+    t.start()
+    assert ck.wait_for_step_after(step=3, timeout_s=30, poll_s=0.01) == 4
+    t.join()
+
+
+# -- HTTP front end ----------------------------------------------------
+def _post(url, doc, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=60):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def served():
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4, 16),
+                        max_latency_s=0.002, max_queue=256)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    yield eng, m, srv, f"http://{host}:{port}"
+    srv.close()
+
+
+def test_server_predict_health_metrics(served):
+    eng, m, srv, url = served
+    rows = _rows(5)
+    code, doc = _post(url + "/predict", {"rows": rows.tolist()})
+    assert code == 200 and doc["n"] == 5
+    assert np.allclose(np.asarray(doc["predictions"]),
+                       np.asarray(m.apply(m.params, rows)), atol=1e-5)
+    # bare-list body works too
+    code, doc = _post(url + "/predict", rows[:2].tolist())
+    assert code == 200 and doc["n"] == 2
+    code, doc = _get(url + "/healthz")
+    assert code == 200 and doc["status"] == "serving"
+    code, doc = _get(url + "/metricsz")
+    assert code == 200 and doc["engine"]["completed"] >= 7
+    assert "counters" in doc["registry"]
+
+
+def test_server_error_mapping(served):
+    eng, _, srv, url = served
+    code, doc = _post(url + "/predict", {"rows": []})
+    assert code == 400
+    code, doc = _post(url + "/predict", {"wrong": 1})
+    assert code == 400
+    code, doc = _get(url + "/nope")
+    assert code == 404
+    with faults.armed("serve.predict"):
+        code, doc = _post(url + "/predict", {"rows": _rows(1).tolist()})
+    assert code == 500 and doc["error"] == "FaultInjected"
+    with faults.armed("serve.enqueue"):
+        code, doc = _post(url + "/predict", {"rows": _rows(1).tolist()})
+    assert code == 500 and doc["error"] == "FaultInjected"
+
+
+def test_server_drain_rejects_then_closes(served):
+    eng, _, srv, url = served
+    code, doc = _post(url + "/predict", {"rows": _rows(3).tolist()})
+    assert code == 200
+    srv.drain(timeout_s=60)
+    # listener closed: late clients get a FAST typed failure
+    with pytest.raises(OSError):
+        urllib.request.urlopen(url + "/healthz", timeout=5)
+    assert eng.draining
+
+
+def test_server_signal_drain_via_preemption(served):
+    eng, _, srv, url = served
+    assert _post(url + "/predict", {"rows": _rows(2).tolist()})[0] == 200
+    try:
+        srv.install_signal_drain(poll_s=0.01)
+        preemption.request(signal.SIGTERM)  # simulated delivery
+        deadline = time.monotonic() + 30
+        while srv.preempted_signum is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.preempted_signum == signal.SIGTERM
+        assert eng.draining and not eng.running
+        with pytest.raises(Overloaded):
+            eng.submit(_rows(1)[0])
+    finally:
+        preemption.clear()
+        preemption.restore()
+
+
+def test_default_port(monkeypatch):
+    monkeypatch.delenv("DK_SERVE_PORT", raising=False)
+    assert default_port() == 8000
+    monkeypatch.setenv("DK_SERVE_PORT", "9100")
+    assert default_port() == 9100
+    monkeypatch.setenv("DK_SERVE_PORT", "junk")
+    assert default_port(fallback=7) == 7
+
+
+# -- offered-load benchmark -------------------------------------------
+def test_run_serving_benchmark_record():
+    rec = run_serving_benchmark(offered_qps=200.0, duration_s=0.5,
+                                feature_dim=4, hidden=(8,),
+                                batch_ladder=(1, 8), warmup=True)
+    assert rec["submitted"] > 0
+    assert rec["completed"] == rec["submitted"]
+    assert rec["rejected"] == 0 and rec["errors"] == 0
+    assert rec["p99_ms"] is not None and rec["p99_ms"] > 0
+    assert rec["retrace_count"] <= rec["retrace_bound"]
+
+
+# -- launch integration + monitor -------------------------------------
+def test_job_serve_port_env_and_config(tmp_path):
+    jobdir = tmp_path / "job"
+    jobdir.mkdir()
+    job = Job("s", "serve1", str(jobdir), hosts=["h0", "h1"],
+              dry_run=True, serve_port=9000)
+    env = job.host_env(1)
+    assert env["DK_SERVE_PORT"] == "9000"
+    assert job.host_env(0)["DK_SERVE_PORT"] == "9000"
+    from dist_keras_tpu.launch.config import JobConfig
+
+    cfg = JobConfig.from_dict({
+        "job_name": "serve1", "job_dir": str(jobdir),
+        "hosts": ["h0"], "serve_port": 9000})
+    assert cfg.to_job(dry_run=True).serve_port == 9000
+    with pytest.raises(ValueError):
+        JobConfig.from_dict({"job_name": "x", "job_dir": str(jobdir),
+                             "serve_port": "9000"})
+
+
+def test_job_monitor_transitions(tmp_path):
+    jobdir = tmp_path / "job"
+    jobdir.mkdir()
+    obs = tmp_path / "obs"
+    w = obs_events.EventWriter(str(obs), rank=0)
+    w.emit("epoch_end", epoch=0)
+    w.close()
+    job = Job("s", "mon1", str(jobdir), hosts=["h0"], dry_run=True,
+              obs_dir=str(obs))
+    lines = job.monitor(interval_s=0.01, max_polls=2, out=None)
+    assert any("rank 0" in ln and "epoch_end" in ln for ln in lines)
+    # second poll with no new events -> no duplicate transition
+    assert sum("rank 0" in ln for ln in lines) == 1
+    # a new event between polls shows as a +N transition
+    w2 = obs_events.EventWriter(str(obs), rank=0)
+    w2.emit("ckpt_save", step=1)
+    w2.close()
+    lines2 = job.monitor(interval_s=0.01, max_polls=1, out=None)
+    assert any("rank 0" in ln for ln in lines2)
+
+
+# -- review-pass regressions ------------------------------------------
+def test_engine_ragged_rows_rejected_at_the_door():
+    # a row whose shape disagrees with the engine's feature shape is a
+    # typed ValueError AT ADMISSION — it can neither wedge the batcher
+    # nor drag an innocent neighbour's request down inside a shared
+    # batch, and it cannot grow the jit-shape set past the ladder
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(4,),
+                        max_latency_s=0.05, max_queue=64)
+    try:
+        f1 = eng.submit(np.zeros(4, np.float32))  # locks the shape
+        with pytest.raises(ValueError, match="feature shape"):
+            eng.submit(np.zeros(7, np.float32))
+        f1.result(timeout=60)  # the well-formed neighbour is untouched
+        # explicit constructor lock rejects even the FIRST bad row
+        eng2 = ServingEngine(m, replicas=1, batch_ladder=(1,),
+                             feature_shape=(4,))
+        with pytest.raises(ValueError, match="feature shape"):
+            eng2.submit(np.zeros(5, np.float32))
+        eng2.close()
+        assert eng.predict(_rows(3), timeout_s=60).shape == (3, 3)
+        out = eng.drain(timeout_s=30)  # and still drains (no wedge)
+        assert out["duration_s"] < 30
+    finally:
+        if eng.running:
+            eng.close()
+
+
+def test_engine_drain_timeout_is_recoverable():
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1,),
+                        max_latency_s=0.001, max_queue=8)
+    gate = threading.Event()
+    orig = eng._apply
+    eng._apply = lambda p, x: (gate.wait(30), orig(p, x))[1]
+    fut = eng.submit(_rows(1)[0])
+    with pytest.raises(TimeoutError):
+        eng.drain(timeout_s=0.05)  # in-flight batch outlives the budget
+    gate.set()
+    fut.result(timeout=30)  # still delivered — never dropped
+    out = eng.drain(timeout_s=30)  # a later drain CAN finish the job
+    assert out["delivered"] == 1
+    assert not eng.running  # workers actually stopped this time
+
+
+def test_server_close_without_start_returns():
+    eng = ServingEngine(_model(), replicas=1, batch_ladder=(1,))
+    srv = ServingServer(eng, port=0)
+    t0 = time.monotonic()
+    srv.close()  # never start()ed: must not block in shutdown()
+    assert time.monotonic() - t0 < 5.0
+    assert not eng.running
+
+
+def test_server_shape_mismatch_is_400(served):
+    eng, _, srv, url = served
+    assert _post(url + "/predict", {"rows": _rows(2).tolist()})[0] == 200
+    code, doc = _post(url + "/predict",
+                      {"rows": [[0.0] * 9]})  # engine serves width 4
+    assert code == 400 and doc["error"] == "bad_request"
+    # well-formed traffic unaffected
+    assert _post(url + "/predict", {"rows": _rows(2).tolist()})[0] == 200
+
+
+def test_report_reads_collect_obs_host_layout(tmp_path):
+    # Job.collect_obs rsyncs each host's log to dest/host_{i}/ — the
+    # report (and therefore Job.monitor pointed at the collect dest)
+    # must see those files without a manual merge step
+    from dist_keras_tpu.observability import report as obs_report
+
+    for rank in (0, 1):
+        sub = tmp_path / f"host_{rank}"
+        w = obs_events.EventWriter(str(sub), rank=rank)
+        w.emit("epoch_end", epoch=rank)
+        w.close()
+    evs = obs_report.read_events(tmp_path)
+    assert {e["rank"] for e in evs} == {0, 1}
+    files = obs_report.event_files(tmp_path)
+    assert len(files) == 2
+    job = Job("s", "mon3", str(tmp_path), hosts=["h0", "h1"],
+              dry_run=True)
+    lines = job.monitor(interval_s=0.01, max_polls=1, out=None,
+                        obs_dir=str(tmp_path))
+    assert any("rank 0" in ln for ln in lines)
+    assert any("rank 1" in ln for ln in lines)
