@@ -38,9 +38,13 @@ in run order:
    (``dist_keras_tpu.serving``), in a CPU-pinned subprocess so it
    still measures when the device probe times out (r05's all-null
    record); also run in the backend-unresponsive early-exit path.
-9. Transformer — composite dp x tp x sp step (ring + flash attention);
+9. Checkpoint-manifest overhead — ``Checkpointer.save`` with vs
+   without ``DK_CKPT_VERIFY`` (integrity manifests) + raw SHA-256
+   throughput, CPU-pinned subprocess; also run in the
+   backend-unresponsive early-exit path, like serving.
+10. Transformer — composite dp x tp x sp step (ring + flash attention);
    new capability, no reference counterpart (vs_baseline: null).
-10. Long-context — T=32k causal step, flash kernels + remat="mlp";
+11. Long-context — T=32k causal step, flash kernels + remat="mlp";
    reports hardware MFU (attention-aware) AND param-only MFU.
 
 Baseline denominators (measured in this image with Keras 3 + TF CPU
@@ -647,6 +651,106 @@ def bench_serving(peak=None, timeout_s=300):
     return rec
 
 
+# The manifest-overhead worker: measures Checkpointer.save wall with
+# integrity manifests ON vs OFF (the DK_CKPT_VERIFY knob — exactly the
+# opt-out an operator would flip) on a fixed-size host pytree, plus the
+# isolated hash cost of the committed payload.  Runs CPU-pinned in a
+# subprocess (same reasoning as bench_serving: a pure host-side
+# measurement that must still land when the device tunnel is wedged,
+# and orbax/jax must never touch the wedged backend in-process).
+_CKPT_MANIFEST_WORKER = r"""
+import json, os, statistics, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer, build_manifest
+
+mb, reps = int(sys.argv[1]), int(sys.argv[2])
+state = {"w": np.random.default_rng(0).standard_normal(
+    mb * 1024 * 1024 // 8)}
+work = tempfile.mkdtemp(prefix="dk_bench_manifest_")
+
+
+def timed_save(verify, rep):
+    os.environ["DK_CKPT_VERIFY"] = "1" if verify else "0"
+    d = os.path.join(work, ("v" if verify else "n") + str(rep))
+    t0 = time.perf_counter()
+    Checkpointer(d, max_to_keep=2).save(1, state)
+    return time.perf_counter() - t0
+
+
+timed_save(False, "warm")  # discarded: the first save pays one-time
+#                            orbax/import costs neither side should own
+# interleaved off/on pairs so fs-cache drift hits both sides equally
+plain, verified = [], []
+for rep in range(reps):
+    plain.append(timed_save(False, rep))
+    verified.append(timed_save(True, rep))
+t0 = time.perf_counter()
+build_manifest(os.path.join(work, "n0", "step_00000001"))
+hash_s = time.perf_counter() - t0
+import shutil
+shutil.rmtree(work, ignore_errors=True)
+p, v = statistics.median(plain), statistics.median(verified)
+print(json.dumps({
+    "payload_mb": mb,
+    "save_s_plain": round(p, 4),
+    "save_s_verified": round(v, 4),
+    "manifest_overhead_s": round(v - p, 4),
+    "manifest_overhead_frac": round((v - p) / p, 4) if p else None,
+    "hash_mb_per_s": round(mb / hash_s, 1) if hash_s else None,
+    "reps": reps,
+}))
+"""
+
+
+def bench_ckpt_manifest(peak=None, mb=64, reps=5, timeout_s=300):
+    """Integrity-manifest cost: ``Checkpointer.save`` with vs without
+    ``DK_CKPT_VERIFY`` (median-of-``reps`` on a ``mb``-MB pytree) plus
+    the raw SHA-256 throughput — so the price of the self-healing layer
+    is tracked in every BENCH round, not asserted once and forgotten."""
+    import subprocess
+    import tempfile
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "XLA_FLAGS" and not k.startswith("DK_CKPT")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (repo + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as f:
+        f.write(_CKPT_MANIFEST_WORKER)
+        script = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, str(mb), str(reps)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=repo)
+    except subprocess.TimeoutExpired:
+        return {"name": "ckpt_manifest_overhead",
+                "error": f"manifest bench timed out after {timeout_s}s"}
+    finally:
+        os.unlink(script)
+    rec = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if proc.returncode != 0 or rec is None:
+        return {"name": "ckpt_manifest_overhead",
+                "error": f"rc={proc.returncode}: "
+                         + (proc.stderr or proc.stdout)[-200:]}
+    rec["name"] = "ckpt_manifest_overhead"
+    rec["platform"] = "cpu"
+    rec["vs_baseline"] = None  # no reference counterpart (the
+    #                            reference has no checkpoint integrity)
+    return rec
+
+
 def _backend_responsive(timeout_s=180):
     """Probe the default backend in a SUBPROCESS with a hard timeout.
 
@@ -787,19 +891,25 @@ def main():
         # anyway so the round still records a real measurement instead
         # of the all-null record r05 left
         _OUT["backend_unresponsive"] = detail
-        print(f"[bench] backend unresponsive, measuring serving only: "
-              f"{detail}", file=sys.stderr, flush=True)
-        t0 = time.time()
-        _obs_emit("bench_config_begin", name="bench_serving")
-        try:
-            row = bench_serving(None)
-        except Exception as e:  # pragma: no cover - last-ditch guard
-            row = {"name": "serving_cpu_offered_load",
-                   "error": repr(e)[:200]}
-        row["duration_s"] = round(time.time() - t0, 1)
-        _obs_emit("bench_config_end", name="bench_serving",
-                  duration_s=row["duration_s"], error=row.get("error"))
-        _OUT["configs"].append(row)
+        print(f"[bench] backend unresponsive, measuring host-side "
+              f"configs only: {detail}", file=sys.stderr, flush=True)
+        # both are CPU-subprocess measurements that never touch the
+        # wedged backend — the round still records real numbers
+        for fn, fallback_name in ((bench_serving,
+                                   "serving_cpu_offered_load"),
+                                  (bench_ckpt_manifest,
+                                   "ckpt_manifest_overhead")):
+            t0 = time.time()
+            _obs_emit("bench_config_begin", name=fn.__name__)
+            try:
+                row = fn(None)
+            except Exception as e:  # pragma: no cover - last-ditch
+                row = {"name": fallback_name, "error": repr(e)[:200]}
+            row["duration_s"] = round(time.time() - t0, 1)
+            _obs_emit("bench_config_end", name=fn.__name__,
+                      duration_s=row["duration_s"],
+                      error=row.get("error"))
+            _OUT["configs"].append(row)
         _emit(last=True)
         return
     _enable_compilation_cache()
@@ -816,8 +926,8 @@ def main():
     for fn in (bench_adag_mnist_cnn, bench_single_mnist_mlp,
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
-               bench_adag_streamed, bench_serving, bench_transformer_tp,
-               bench_long_context):
+               bench_adag_streamed, bench_serving, bench_ckpt_manifest,
+               bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,

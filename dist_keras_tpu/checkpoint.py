@@ -37,6 +37,29 @@ save killed between one host's rename and full commit (the
 falls back to the last FULLY committed step on every host.  Orphan GC
 and retention are leader-only in multi-host mode — two hosts must not
 race a third host's in-flight rename.
+
+This PR — INTEGRITY MANIFESTS (the self-healing layer).  The two-phase
+protocol guarantees a committed step is *complete*; nothing yet
+guaranteed it is *readable* — a torn write past the rename, a bad
+disk, or a truncated payload was only discovered when ``restore()``
+exploded mid-recovery.  Now every payload write ends with a
+``manifest.json`` in the staging dir (per-file byte sizes + SHA-256
+plus a whole-tree digest), written BEFORE the commit rename so the
+existing atomic protocols make the manifest exactly as durable as the
+payload.  ``verify(step)`` is a public, strictly READ-ONLY probe
+(serving-side watchers call it before a hot swap): ``"ok"`` when every
+byte hashes clean, ``"unverifiable"`` for a pre-manifest (legacy)
+checkpoint — old runs keep restoring — and a typed
+:class:`CheckpointCorrupt` naming each mismatched file otherwise.
+``restore()`` verifies by default (skip via ``verify=False`` or
+``DK_CKPT_VERIFY=0``); on corruption it emits a ``ckpt_corrupt``
+event, QUARANTINES the bad step to ``step_N.corrupt`` (leader-only on
+pods, mirroring ``_gc_orphans`` — quarantined dirs are evidence, never
+GC'd, retired only by retention) and falls back to the previous
+promoted step automatically, so a bad disk costs one checkpoint
+cadence instead of the run.  ``latest_verified_step()`` is the
+read-only probe the auto-resume supervisor
+(``resilience.supervisor``) restarts against.
 """
 
 from __future__ import annotations
@@ -57,6 +80,39 @@ except Exception:  # pragma: no cover - orbax is in the image
     _HAVE_ORBAX = False
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint payload failed its integrity-manifest verification.
+
+    Carries the ``step``, the payload ``path`` and the list of
+    ``problems`` (one human-readable string per mismatched / missing /
+    unlisted file) so a post-mortem names exactly which bytes rotted.
+    Typed — the supervisor and the serving watcher both branch on it.
+    """
+
+    def __init__(self, step, path, problems):
+        self.step = step
+        self.path = path
+        self.problems = list(problems)
+        head = "; ".join(self.problems[:3])
+        more = (f" (+{len(self.problems) - 3} more)"
+                if len(self.problems) > 3 else "")
+        super().__init__(
+            f"checkpoint step {step} at {path} failed integrity "
+            f"verification: {head}{more}")
+
+
+def _verify_enabled():
+    """Integrity manifests default ON: ``save`` writes ``manifest.json``
+    into every payload and ``restore`` verifies it.  ``DK_CKPT_VERIFY=0``
+    opts out of BOTH (the bench measures the hash cost via exactly this
+    knob); a per-call ``restore(verify=...)`` overrides the read side
+    only."""
+    return os.environ.get("DK_CKPT_VERIFY", "1").lower() \
+        not in ("0", "off", "no", "false")
 
 
 def _two_phase_enabled():
@@ -102,6 +158,122 @@ def _fsync_tree(root):
             finally:
                 os.close(fd)
         _fsync_dir(dirpath)
+
+
+def _hash_file(path, chunk=1 << 20):
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def build_manifest(root):
+    """Integrity manifest of every file under ``root`` (the manifest
+    file itself excluded): relative path -> {bytes, sha256}, plus a
+    whole-tree digest over the sorted entries so a MISSING or EXTRA
+    file is as detectable as a flipped bit."""
+    import hashlib
+
+    files = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            if rel == MANIFEST_NAME:
+                continue
+            files[rel] = {"bytes": os.path.getsize(full),
+                          "sha256": _hash_file(full)}
+    tree = hashlib.sha256("\n".join(
+        f"{rel}:{files[rel]['bytes']}:{files[rel]['sha256']}"
+        for rel in sorted(files)).encode()).hexdigest()
+    return {"format": 1, "files": files, "tree_sha256": tree}
+
+
+def write_manifest(root):
+    """Write ``build_manifest(root)`` into ``root/manifest.json``
+    atomically (tmp + rename: a kill mid-write leaves no torn manifest
+    that would condemn a healthy payload)."""
+    manifest = build_manifest(root)
+    path = os.path.join(root, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=0, sort_keys=True)
+    os.replace(tmp, path)
+    return manifest
+
+
+def verify_manifest(root):
+    """-> ("ok", []) | ("unverifiable", []) | ("corrupt", problems).
+
+    Strictly read-only.  ``unverifiable`` = no manifest (a legacy
+    checkpoint written before integrity manifests, or with
+    ``DK_CKPT_VERIFY=0``): old runs must keep restoring, so absence is
+    SOFT — the caller decides whether to accept it."""
+    path = os.path.join(root, MANIFEST_NAME)
+    if not os.path.isdir(root):
+        return "corrupt", [f"payload dir {root} missing"]
+    if not os.path.exists(path):
+        return "unverifiable", []
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        listed = manifest["files"]
+        # shape-check before the walk: valid JSON of the wrong SHAPE
+        # (a torn rewrite leaving e.g. a list, or string entries) must
+        # stay a typed "corrupt" verdict here — leaked untyped out of
+        # the comparison below, supervise() would read the TypeError
+        # as a fatal config error instead of healing around the step
+        if not isinstance(listed, dict) or not all(
+                isinstance(v, dict) for v in listed.values()):
+            raise TypeError("files table malformed")
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        # the manifest ITSELF rotted: as damning as a payload mismatch
+        return "corrupt", [f"manifest unreadable: {type(e).__name__}: "
+                           f"{e}"]
+    problems = []
+    seen = set()
+    for rel in sorted(listed):
+        want = listed[rel]
+        full = os.path.join(root, rel)
+        seen.add(rel)
+        if not os.path.exists(full):
+            problems.append(f"{rel}: listed but missing")
+            continue
+        size = os.path.getsize(full)
+        if size != want.get("bytes"):
+            problems.append(
+                f"{rel}: {size} bytes, manifest says {want.get('bytes')}")
+            continue  # hash would fail too; size names the tear better
+        got = _hash_file(full)
+        if got != want.get("sha256"):
+            problems.append(f"{rel}: sha256 {got[:12]}… != manifest "
+                            f"{str(want.get('sha256'))[:12]}…")
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            if rel != MANIFEST_NAME and rel not in seen:
+                problems.append(f"{rel}: present but not in manifest")
+    # the tree digest must round-trip: recomputed over the manifest's
+    # own (path, bytes, sha256) entries it detects a files table that
+    # was EDITED after signing (per-file hashes rewritten to match a
+    # rotted payload would pass every check above; the stale
+    # tree_sha256 still convicts them)
+    import hashlib
+
+    tree = hashlib.sha256("\n".join(
+        f"{rel}:{listed[rel].get('bytes')}:{listed[rel].get('sha256')}"
+        for rel in sorted(listed)).encode()).hexdigest()
+    if tree != manifest.get("tree_sha256"):
+        problems.append(
+            f"tree digest mismatch: recomputed {tree[:12]}… != manifest "
+            f"tree_sha256 {str(manifest.get('tree_sha256'))[:12]}…")
+    return ("corrupt", problems) if problems else ("ok", [])
 
 
 def save_model(model, path):
@@ -270,6 +442,12 @@ class Checkpointer:
                 if os.path.exists(full[:-4]):  # superseded retired copy
                     shutil.rmtree(full, ignore_errors=True)
                 continue  # sole copy of its step: keep (read path)
+            if name.endswith(".corrupt") and _STEP_RE.match(name[:-8]):
+                # quarantined evidence: kept for the post-mortem, only
+                # retention retires it (an orphan sweep deleting it
+                # would erase the one artifact that explains the
+                # ckpt_corrupt event)
+                continue
             if world > 1 and name.endswith(".mh") \
                     and _STEP_RE.match(name[:-3]):
                 # a staging dir for a NEWER step than the one this
@@ -371,6 +549,12 @@ class Checkpointer:
 
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if _verify_enabled():
+            # the integrity manifest rides INSIDE the staging dir, so
+            # the commit rename that publishes the payload publishes
+            # the manifest with it — exactly as durable, never a
+            # separate commit instant
+            write_manifest(tmp)
         if self.fsync:
             _fsync_tree(tmp)
 
@@ -521,21 +705,134 @@ class Checkpointer:
         if rank == 0:
             self._retain()
 
-    def restore(self, step=None, template=None):
-        """Restore ``step`` (default: latest). ``template``: a pytree with
-        the target structure/dtypes (required by orbax for exact restore)."""
+    # -- integrity: verify / quarantine / verified fallback -------------
+    def verify(self, step=None):
+        """Public READ-ONLY integrity probe of ``step`` (default:
+        latest) — this rank's payload, the same bytes :meth:`restore`
+        would load.  -> ``"ok"`` (every byte hashes clean against the
+        manifest) or ``"unverifiable"`` (pre-manifest legacy checkpoint
+        — soft, old runs keep restoring).  Raises a typed
+        :class:`CheckpointCorrupt` naming each mismatched file.  Never
+        mutates the directory: a serving-side watcher probes a live
+        training run's checkpoints with this before every hot swap."""
+        import time as _time
+
+        from dist_keras_tpu.observability import events
+
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        step, state = self._restore_inner(step, template)
-        # emitted AFTER the load: like ckpt_save, only a COMPLETED
-        # restore is recorded — a crash-loop whose every restart fails
-        # to restore must not read as N successful restores
-        from dist_keras_tpu.observability import events
+        step = int(step)
+        path = self._payload_dir(self._read_path(step))
+        t0 = _time.perf_counter()
+        status, problems = verify_manifest(path)
+        if status == "corrupt":
+            events.emit("ckpt_corrupt", step=step,
+                        n_problems=len(problems),
+                        problems=problems[:3])
+            raise CheckpointCorrupt(step, path, problems)
+        events.emit("ckpt_verify", step=step, status=status,
+                    duration_s=_time.perf_counter() - t0)
+        return status
 
-        events.emit("ckpt_restore", step=int(step))
-        return step, state
+    def latest_verified_step(self):
+        """Latest step whose payload verifies (``"ok"`` or legacy
+        ``"unverifiable"``), or None.  STRICTLY read-only — corrupt
+        steps are skipped, not quarantined (this is the supervisor's
+        restart probe, which may run from a non-writer process)."""
+        for step in reversed(self.all_steps()):
+            try:
+                status, _problems = verify_manifest(
+                    self._payload_dir(self._read_path(step)))
+            except (OSError, RuntimeError):
+                continue  # unreadable layout: as unusable as corrupt
+            if status != "corrupt":
+                return step
+        return None
+
+    def _quarantine(self, step):
+        """Retire a corrupt step to ``step_N.corrupt`` so no reader
+        (``all_steps``/``latest_step``/a serving watcher) ever counts it
+        again, while the bytes stay on disk as post-mortem evidence
+        (``_gc_orphans`` skips ``.corrupt``; only retention retires
+        them).  Leader-only on pods, mirroring ``_gc_orphans`` — a
+        non-leader renaming inside the shared directory could race the
+        leader's own sweep."""
+        import shutil
+
+        rank, world = self._coord_ids()
+        if world > 1 and rank != 0 and _two_phase_enabled():
+            return False
+        path = self._read_path(step)  # committed dir OR stranded .old
+        target = self._step_dir(step) + ".corrupt"
+        try:
+            shutil.rmtree(target, ignore_errors=True)  # stale quarantine
+            os.rename(path, target)
+        except OSError:  # pragma: no cover - raced writer / read-only fs
+            return False
+        if self.fsync:
+            _fsync_dir(self.directory)
+        return True
+
+    def restore(self, step=None, template=None, verify=None):
+        """Restore ``step`` (default: latest). ``template``: a pytree with
+        the target structure/dtypes (required by orbax for exact restore).
+
+        ``verify`` (default: ``DK_CKPT_VERIFY``, on): check the payload
+        against its integrity manifest first.  A corrupt step emits
+        ``ckpt_corrupt``, is quarantined to ``step_N.corrupt`` and the
+        restore FALLS BACK to the previous promoted step automatically
+        — recovery self-heals instead of exploding mid-restore.  Only
+        when no verified step remains does the original
+        :class:`CheckpointCorrupt` propagate."""
+        check = _verify_enabled() if verify is None else bool(verify)
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        step = int(step)
+        while True:
+            if check:
+                try:
+                    self.verify(step)  # emits ckpt_verify / ckpt_corrupt
+                except CheckpointCorrupt as e:
+                    rank, world = self._coord_ids()
+                    if world > 1:
+                        # a PER-RANK fallback on a pod would silently
+                        # diverge the cluster: this rank restoring
+                        # step N-1 while peers (whose payloads hash
+                        # clean) restore step N is worse than the loud
+                        # pre-manifest crash.  Choosing a common
+                        # fallback step needs a cluster agreement the
+                        # restore path cannot assume (the coordinator
+                        # may be poisoned or not yet constructed), so
+                        # the typed verdict propagates and the
+                        # supervisor/operator restarts the POD from a
+                        # step all ranks verify.  This holds with
+                        # two-phase opted OUT too (DK_CKPT_TWO_PHASE=0,
+                        # per-host local dirs): one host's local copy
+                        # rotting must not let that rank quietly resume
+                        # from N-1 while its peers resume from N.
+                        raise CheckpointCorrupt(
+                            e.step, e.path, e.problems + [
+                                "multi-host restore does not fall back "
+                                "per-rank (peers would diverge); "
+                                "restart the pod from an earlier step"])
+                    self._quarantine(step)
+                    fallback = [s for s in self.all_steps() if s < step]
+                    if not fallback:
+                        raise
+                    step = fallback[-1]
+                    continue
+            step, state = self._restore_inner(step, template)
+            # emitted AFTER the load: like ckpt_save, only a COMPLETED
+            # restore is recorded — a crash-loop whose every restart
+            # fails to restore must not read as N successful restores
+            from dist_keras_tpu.observability import events
+
+            events.emit("ckpt_restore", step=int(step))
+            return step, state
 
     def _restore_inner(self, step, template):
         path = self._payload_dir(self._read_path(step))
@@ -569,3 +866,17 @@ class Checkpointer:
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
             shutil.rmtree(self._step_dir(step) + ".old",
                           ignore_errors=True)
+        # quarantined evidence is retired on the same horizon as the
+        # live steps it rode with (it never counts toward max_to_keep,
+        # but must not accumulate forever on a long run with a flaky
+        # disk) — anything older than the oldest RETAINED step goes
+        if steps:
+            import shutil
+
+            horizon = steps[max(excess, 0)] if excess > 0 else steps[0]
+            for name in os.listdir(self.directory):
+                if name.endswith(".corrupt") \
+                        and _STEP_RE.match(name[:-8]) \
+                        and int(name[:-8].split("_")[1]) < horizon:
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)

@@ -153,7 +153,14 @@ def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
             # complete on the peers — ANY further barrier (timed or
             # not) would pair this host's op N+1 with their op N (the
             # same desync hazard Coordinator poisoning guards against)
-            raise RuntimeError(
+            from dist_keras_tpu.resilience.coordination import (
+                CoordinatorPoisoned,
+            )
+
+            # typed (not a bare RuntimeError): the auto-resume
+            # supervisor must classify a desynced collective stream as
+            # never-retried — only a fresh incarnation can help
+            raise CoordinatorPoisoned(
                 "comm.barrier is poisoned: a previous timed "
                 f"barrier gave up ({_barrier_poisoned}) and this "
                 "host's position in the collective stream is "

@@ -63,6 +63,13 @@ class JobConfig:
     # entrypoint that starts serving.ServingServer(port=None) binds it
     # on every host, so one descriptor launches a serving fleet
     serve_port: int | None = None
+    # launcher-side auto-resume (resilience.supervisor): an int arms
+    # Job.supervise_run() with that many whole-pod relaunch waves per
+    # rolling 600 s window (true = the default budget of 3); a dict
+    # gives the full knobs {"max_restarts", "budget_window_s",
+    # "interval_s", "grace_s"}.  Requires coord_dir (dead-host
+    # detection reads the heartbeats there).
+    supervise: int | bool | dict | None = None
 
     # operator-facing JSON surface: validate types, not just names — a
     # string where a list belongs (hosts: "localhost") would otherwise
@@ -76,7 +83,8 @@ class JobConfig:
               "coord_dir": (str, type(None)),
               "coord_timeout_s": (int, float, type(None)),
               "obs_dir": (str, type(None)),
-              "serve_port": (int, type(None))}
+              "serve_port": (int, type(None)),
+              "supervise": (int, bool, dict, type(None))}
 
     @classmethod
     def from_dict(cls, d):
@@ -94,7 +102,13 @@ class JobConfig:
                              f"{sorted(missing)}")
         for name, value in d.items():
             want = cls._TYPES[name]
-            if not isinstance(value, want) or isinstance(value, bool):
+            # bool subclasses int: reject it for int-typed fields unless
+            # the field genuinely accepts bool (supervise: true = the
+            # default relaunch budget)
+            wants_bool = bool in (want if isinstance(want, tuple)
+                                  else (want,))
+            if not isinstance(value, want) \
+                    or (isinstance(value, bool) and not wants_bool):
                 names = " | ".join(
                     t.__name__ for t in
                     (want if isinstance(want, tuple) else (want,)))

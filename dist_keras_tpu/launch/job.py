@@ -35,6 +35,24 @@ Round 9 — serving jobs + live monitoring: ``serve_port`` exports
 ``Job.monitor(interval_s)`` is the launcher-side live loop tailing
 ``dead_hosts()`` plus the merged observability report, printing only
 transitions.
+
+This PR — launcher-side auto-resume: ``Job(supervise=N)`` (or a dict
+of knobs) arms :meth:`supervise_run`, which watches ``dead_hosts()``
+and, when any host is heartbeat-dead, RELAUNCHES the whole pod as a
+fresh incarnation over the existing rsync/ssh retry surfaces, rotating
+``DK_COORD_SESSION`` per wave so the new incarnation's FileCoordinator
+rendezvous never mixes with the dead one's markers (membership is
+fixed per incarnation — survivors of a dead peer are already dying of
+``PeerLost``, so the recovery unit is the pod, torchelastic-style);
+liveness is then judged in the NEW session's heartbeat directory.  The
+entrypoint is expected to resume from the committed checkpoint itself
+(``Trainer(resume=True)`` — restore verifies integrity manifests and
+falls back past a corrupt step).  The relaunch budget is the same
+rolling-window
+:class:`~dist_keras_tpu.resilience.supervisor.RestartBudget` the
+in-process ``supervise()`` loop uses, one recording per WAVE: past it,
+a typed ``CrashLoop`` with the window's evidence — a flapping host
+never flaps forever.
 """
 
 from __future__ import annotations
@@ -82,7 +100,7 @@ class Job:
                  remote_root="~/jobs", python="python3", dry_run=False,
                  retries=2, retry_backoff=0.5, launch_retries=0,
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
-                 serve_port=None):
+                 serve_port=None, supervise=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -161,6 +179,38 @@ class Job:
         # the same operator-chosen port on every host — one launch-config
         # knob turns a training job descriptor into a serving-job one
         self.serve_port = None if serve_port is None else int(serve_port)
+        # supervise: arm supervise_run()'s pod-relaunch budget.
+        # int N = N relaunch WAVES per rolling 600 s window; a dict
+        # gives the full knobs {"max_restarts", "budget_window_s",
+        # "interval_s", "grace_s"}.  None/False = supervise_run()
+        # refuses (the operator must opt into automatic relaunches: a
+        # relaunch against a half-dead pod is an action, not an
+        # observation).
+        if supervise is None or supervise is False:
+            self.supervise = None
+        elif isinstance(supervise, dict):
+            unknown = set(supervise) - {"max_restarts",
+                                        "budget_window_s", "interval_s",
+                                        "grace_s"}
+            if unknown:
+                raise ValueError(
+                    f"unknown supervise knob(s) {sorted(unknown)}; "
+                    "valid: max_restarts, budget_window_s, interval_s, "
+                    "grace_s")
+            self.supervise = {
+                "max_restarts": int(supervise.get("max_restarts", 3)),
+                "budget_window_s":
+                    float(supervise.get("budget_window_s", 600.0)),
+                "interval_s": float(supervise.get("interval_s", 10.0)),
+                "grace_s": float(supervise.get("grace_s", 30.0)),
+            }
+        else:
+            # True -> the default budget; an int names it exactly
+            self.supervise = {
+                "max_restarts": (3 if supervise is True
+                                 else int(supervise)),
+                "budget_window_s": 600.0,
+                "interval_s": 10.0, "grace_s": 30.0}
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
@@ -191,20 +241,28 @@ class Job:
         return f"{self.remote_root}/{self.job_name}"
 
     # -- API (send ~ job_deployment.py:~60) ----------------------------
+    def sync_host(self, host):
+        """rsync the job directory to ONE host (retried with backoff);
+        -> the final rc.  The per-host unit :meth:`supervise_run`
+        re-runs when it relaunches a dead host."""
+        return self._run_retried([
+            "rsync", "-az", "--delete", self.job_dir + "/",
+            f"{host}:{self._remote_dir()}/"], point="job.rsync")
+
     def sync(self):
         """rsync the job directory to every host (each host's command
         retried with backoff before counting as failed)."""
         rc = 0
         for host in self.hosts:
-            rc |= self._run_retried([
-                "rsync", "-az", "--delete", self.job_dir + "/",
-                f"{host}:{self._remote_dir()}/"], point="job.rsync")
+            rc |= self.sync_host(host)
         return rc
 
-    def host_env(self, pid):
+    def host_env(self, pid, session=None):
         """The jax.distributed environment exported on host ``pid`` —
         exactly the variables ``comm.initialize`` consumes
-        (comm/backend.py:30)."""
+        (comm/backend.py:30).  ``session`` (supervise_run's relaunch
+        counter) additionally exports ``DK_COORD_SESSION``, rotating
+        the FileCoordinator rendezvous per incarnation."""
         if not self.hosts:
             raise ValueError("Job needs at least one host")
         env = {
@@ -230,9 +288,11 @@ class Job:
         if self.serve_port is not None:
             # serving plane: ServingServer(port=None) binds this
             env["DK_SERVE_PORT"] = str(self.serve_port)
+        if session is not None:
+            env["DK_COORD_SESSION"] = str(session)
         return env
 
-    def dead_hosts(self, stale_after_s=None):
+    def dead_hosts(self, stale_after_s=None, session=None):
         """(rank, host) pairs whose liveness file under ``coord_dir`` is
         missing or stale — the launcher-side half of dead-peer
         detection, so an operator (or Punchcard) sees WHICH host died
@@ -240,7 +300,9 @@ class Job:
         path this process can read (shared filesystem); [] when no
         liveness info exists yet.  The default stale window is the
         workers' own (``DK_COORD_STALE_S``, 10s) so launcher and hosts
-        judge liveness by the same clock."""
+        judge liveness by the same clock.  ``session`` probes a rotated
+        ``DK_COORD_SESSION`` incarnation (what :meth:`supervise_run`
+        passes after a relaunch wave)."""
         if not self.coord_dir:
             raise ValueError("Job has no coord_dir: no liveness files "
                              "to inspect")
@@ -250,7 +312,7 @@ class Job:
         # the workers do, so launcher and hosts agree on the path
         dead = coordination.dead_peers_at(
             self.coord_dir, self.num_processes,
-            stale_after_s=stale_after_s)
+            stale_after_s=stale_after_s, session=session)
         return [(r, self.hosts[r] if r < len(self.hosts) else None)
                 for r in dead]
 
@@ -359,29 +421,305 @@ class Job:
                 hostdir + "/"], point="job.rsync")
         return rc
 
-    def launch(self):
+    @staticmethod
+    def _shq_path(path):
+        """``shlex.quote`` for a path interpolated into a REMOTE shell
+        command, preserving a leading ``~`` outside the quotes (quoted
+        whole, the remote shell would take the tilde literally — the
+        workers expanduser() the very same string in python, so both
+        sides must resolve it to the same home-relative path)."""
+        p = str(path)
+        if p == "~":
+            return '"$HOME"'
+        if p.startswith("~/"):
+            return '"$HOME"' + shlex.quote(p[1:])
+        return shlex.quote(p)
+
+    def _rc_remote_dir(self, session=None):
+        """Remote-shell path of the per-incarnation exit-code directory
+        under the SHARED ``coord_dir`` (mirrors the heartbeat layout:
+        ``<coord_dir>[/<session>]/rc``); None without a coord_dir."""
+        if not self.coord_dir:
+            return None
+        root = str(self.coord_dir)
+        if session is not None:
+            root = f"{root}/{session}"
+        return f"{root}/rc"
+
+    def launch_host(self, pid, session=None):
+        """Start the entrypoint on ONE host under its jax.distributed
+        env; -> rc.  ``session`` rotates ``DK_COORD_SESSION`` (see
+        :meth:`host_env`) and names a per-incarnation log file, so a
+        relaunch wave never truncates the dead incarnation's
+        post-mortem.
+
+        The entrypoint runs under ``setsid`` in its OWN process group
+        whose leader pid lands in ``job.pid`` INSIDE the job directory
+        — the handle :meth:`stop_host` needs to retire a survivor
+        before a wave (a plain ``nohup cmd & echo $!`` records the
+        wrapper subshell forked for the backgrounded compound list, in
+        the login cwd, and a TERM to it never reaches the python
+        child).  With a ``coord_dir``, a wrapper shell in that group
+        also records the entrypoint's EXIT CODE into the shared
+        ``<coord_dir>[/<session>]/rc/rank_{pid}`` once it exits —
+        :meth:`supervise_run`'s positive evidence that a
+        heartbeat-silent rank COMPLETED (rc 0) or died typed (rc N)
+        rather than went dark mid-run."""
+        host = self.hosts[pid]
+        env = " ".join(f"{k}={shlex.quote(v)}"
+                       for k, v in self.host_env(pid,
+                                                 session=session).items())
+        # every manifest-sourced field is quoted before it reaches the
+        # remote shell (Punchcard manifests are user-editable JSON)
+        # python may be a multi-word command ("python3 -u"): split it,
+        # then quote each word
+        python = " ".join(shlex.quote(w)
+                          for w in shlex.split(self.python))
+        log = "job.log" if session is None else f"job.log.{session}"
+        inner = f"{env} {python} {shlex.quote(self.entrypoint)}"
+        rc_dir = self._rc_remote_dir(session)
+        mkdir = ""
+        if rc_dir is not None:
+            # the rc write happens INSIDE the detached group: it
+            # survives the launching ssh, but a stop_host group-TERM
+            # (or machine death) kills the wrapper too — a dead-dark
+            # incarnation leaves NO rc, exactly the no-evidence state
+            # the heartbeat staleness verdict covers
+            # quoted like every other manifest-sourced field above —
+            # coord_dir may hold spaces or shell metacharacters
+            rc_q = self._shq_path(rc_dir)
+            inner = f"{inner}; echo $? > {rc_q}/rank_{pid}"
+            mkdir = f"mkdir -p {rc_q} && "
+        # non-idempotent (remote nohup fork): retried only when the
+        # operator opted in via launch_retries — see __init__
+        return self._run_retried([
+            "ssh", host,
+            f"cd {self._remote_dir()} && {mkdir}"
+            f"{{ nohup setsid sh -c {shlex.quote(inner)} "
+            f"> {log} 2>&1 & echo $! > job.pid; }}"], point="job.ssh",
+            policy=self.launch_retry_policy)
+
+    def stop_host(self, host):
+        """Best-effort SIGTERM to the last-launched entrypoint's whole
+        PROCESS GROUP on ONE host (negative-pid kill of the ``setsid``
+        leader recorded in ``job.pid`` — the group, not just the
+        wrapper, so the python child is reached); -> rc, which callers
+        typically IGNORE — the host may be unreachable or the process
+        already gone, and either way the caller's relaunch must
+        proceed.  :meth:`supervise_run` sends this to every host
+        before a relaunch wave: a SURVIVOR of a partial pod death is
+        still alive (dying slowly of ``PeerLost`` at its next
+        collective deadline, up to ``DK_COORD_TIMEOUT_S`` away) and
+        must not keep writing into the checkpoint directory the new
+        incarnation is about to own.  TERM, not KILL: the survivor's
+        preemption handler gets its boundary-checkpoint attempt, which
+        on a pod with a dead peer dies TYPED at the commit barrier
+        without promoting — the two-phase protocol keeps a half-pod
+        save invisible."""
+        return self._run([
+            "ssh", host,
+            f"cd {self._remote_dir()} && test -f job.pid && "
+            'kill -s TERM -- "-$(cat job.pid)" 2>/dev/null; true'])
+
+    def host_rcs(self, session=None):
+        """{rank: exit code} for every rank whose launch wrapper
+        recorded one under ``coord_dir`` (see :meth:`launch_host`) —
+        positive completed/crashed evidence, launcher-readable on the
+        shared filesystem.  Unreadable or garbled entries are skipped
+        (a torn ``echo`` mid-write is transient)."""
+        if not self.coord_dir:
+            raise ValueError("Job has no coord_dir: no rc files "
+                             "to inspect")
+        root = os.path.expanduser(str(self.coord_dir))
+        if session is not None:
+            root = os.path.join(root, str(session))
+        rcs = {}
+        try:
+            names = os.listdir(os.path.join(root, "rc"))
+        except OSError:
+            return rcs
+        for name in names:
+            m = re.match(r"^rank_(\d+)$", name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(root, "rc", name)) as f:
+                    rcs[int(m.group(1))] = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+        return rcs
+
+    def launch(self, session=None):
         """Start the entrypoint on every host under jax.distributed env."""
         if not self.hosts:
             raise ValueError("Job needs at least one host to launch")
         rc = 0
-        for pid, host in enumerate(self.hosts):
-            env = " ".join(f"{k}={shlex.quote(v)}"
-                           for k, v in self.host_env(pid).items())
-            # every manifest-sourced field is quoted before it reaches the
-            # remote shell (Punchcard manifests are user-editable JSON)
-            # python may be a multi-word command ("python3 -u"): split it,
-            # then quote each word
-            python = " ".join(shlex.quote(w)
-                              for w in shlex.split(self.python))
-            # non-idempotent (remote nohup fork): retried only when the
-            # operator opted in via launch_retries — see __init__
-            rc |= self._run_retried([
-                "ssh", host,
-                f"cd {self._remote_dir()} && {env} nohup "
-                f"{python} {shlex.quote(self.entrypoint)} "
-                f"> job.log 2>&1 &"], point="job.ssh",
-                policy=self.launch_retry_policy)
+        for pid in range(len(self.hosts)):
+            rc |= self.launch_host(pid, session=session)
         return rc
+
+    def supervise_run(self, max_polls=None, out=print,
+                      stale_after_s=None):
+        """Launcher-side auto-resume loop: poll :meth:`dead_hosts` and,
+        when any host is heartbeat-dead, relaunch the WHOLE pod as a
+        fresh incarnation (re-sync + ssh launch per host, the same
+        retried surfaces as :meth:`send`) under a rotated
+        ``DK_COORD_SESSION``.  Whole-pod, not per-host: group
+        membership is fixed per incarnation (a FileCoordinator world /
+        ``jax.distributed`` group cannot admit a replacement member
+        mid-stream — the survivors are already dying of ``PeerLost``),
+        so the recovery unit is the incarnation, torchelastic-style.
+        Subsequent polls judge liveness in the NEW session's heartbeat
+        directory (``dead_hosts(session=...)``) after a ``grace_s``
+        startup window, so one slow process start does not burn the
+        budget.  Verdicts also weigh the launch wrappers' exit-code
+        files (:meth:`host_rcs`): rc 0 exempts a COMPLETED rank —
+        all-zero rcs end supervision, since a finished pod's stale
+        heartbeats are not a death — while a nonzero rc convicts a
+        rank even when it died before its first beat.  The
+        relaunched entrypoint is expected to pass
+        ``resume=True`` to its trainer — restore picks the latest
+        VERIFIED committed step (``checkpoint.py`` integrity
+        manifests), so a relaunch continues from the agreed chunk.
+
+        Budget: ``Job(supervise=N)``'s rolling-window
+        :class:`~dist_keras_tpu.resilience.supervisor.RestartBudget`,
+        one recording per relaunch WAVE (a single failure that
+        cascades to whole-pod death is one event, not num_hosts of
+        them).  Past it, a typed ``CrashLoop`` carrying the window's
+        evidence (which ranks, when) — flapping hardware becomes an
+        operator page, not an infinite relaunch loop.  ``max_polls``
+        bounds the loop for tests/one-shot probes; the None default
+        supervises until KeyboardInterrupt.
+        -> list of ``(dead_ranks, session)`` waves performed."""
+        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.resilience.supervisor import (
+            CrashLoop,
+            RestartBudget,
+        )
+
+        if self.supervise is None:
+            raise ValueError(
+                "Job was not armed for supervision — construct with "
+                "supervise=N (relaunch budget) to opt in")
+        if not self.coord_dir:
+            raise ValueError(
+                "supervise_run needs coord_dir: dead-host detection "
+                "reads the heartbeat files there")
+        budget = RestartBudget(self.supervise["max_restarts"],
+                               self.supervise["budget_window_s"])
+        interval_s = self.supervise["interval_s"]
+        grace_s = self.supervise["grace_s"]
+        relaunched = []
+        session = 0
+        last_wave = None  # monotonic t of the last relaunch wave
+        polls = 0
+        try:
+            while max_polls is None or polls < max_polls:
+                now = time.monotonic()
+                # the fresh incarnation needs grace_s before its first
+                # heartbeats can exist — judging the new session's
+                # empty directory immediately would read as all-dead
+                if last_wave is None or now - last_wave >= grace_s:
+                    try:
+                        dead = self.dead_hosts(
+                            stale_after_s=stale_after_s,
+                            session=session if session else None)
+                        if session and not dead and not os.path.isdir(
+                                os.path.join(
+                                    os.path.expanduser(
+                                        str(self.coord_dir)),
+                                    str(session), "hb")):
+                            # dead_peers' absence-of-evidence rule
+                            # (no hb dir -> no verdict) must not hide
+                            # a wave that never came up: the launcher
+                            # LAUNCHED this incarnation, so total
+                            # heartbeat silence past grace_s IS
+                            # evidence — an all-host rsync/ssh failure
+                            # or instant crash would otherwise stall
+                            # supervision forever with the pod down
+                            dead = list(enumerate(self.hosts))
+                    except OSError:
+                        dead = []  # unreadable poll: no verdict
+                    dead = [(r, h) for r, h in dead if h is not None]
+                    # exit-code evidence from the launch wrappers (see
+                    # launch_host): heartbeat staleness alone cannot
+                    # tell a FINISHED run from a dead one — a rank
+                    # whose wrapper recorded rc 0 COMPLETED, and its
+                    # stale heartbeat is the normal end of a finished
+                    # run, not a death; a NONZERO rc is positive crash
+                    # evidence even when the pod died before its first
+                    # beat (no hb dir -> heartbeats give no verdict)
+                    rcs = self.host_rcs(
+                        session=session if session else None)
+                    dead = [(r, h) for r, h in dead
+                            if rcs.get(r) != 0]
+                    for r in sorted(rcs):
+                        if rcs[r] != 0 and r < len(self.hosts) and \
+                                all(r != dr for dr, _ in dead):
+                            dead.append((r, self.hosts[r]))
+                    if rcs and all(rcs.get(r) == 0
+                                   for r in range(self.num_processes)):
+                        if out is not None:
+                            out("[supervise] every rank exited rc=0 "
+                                "— run complete")
+                        return relaunched
+                else:
+                    dead = []
+                if dead:
+                    names = ", ".join(f"rank {r} ({h})"
+                                      for r, h in dead)
+                    if not budget.record("hosts_dead", names):
+                        events.emit(
+                            "supervisor_giveup", reason="crash_loop",
+                            ranks=[r for r, _ in dead],
+                            restarts_in_window=len(budget.evidence),
+                            window_s=budget.window_s)
+                        raise CrashLoop(
+                            f"pod relaunch budget exhausted: "
+                            f"{len(budget.evidence)} dead-host waves "
+                            f"in the last {budget.window_s:.0f}s "
+                            f"(budget "
+                            f"{self.supervise['max_restarts']}) — "
+                            f"last: {names}",
+                            evidence=budget.evidence)
+                    session += 1
+                    if out is not None:
+                        out(f"[supervise] dead: {names} — relaunching "
+                            f"the pod (session {session})")
+                    events.emit("supervisor_restart",
+                                ranks=[r for r, _ in dead],
+                                session=session)
+                    # retire the OLD incarnation first: survivors are
+                    # already dying of PeerLost but may be a full
+                    # collective deadline away from noticing, and two
+                    # incarnations must never write the checkpoint
+                    # directory concurrently (rc ignored — best-effort
+                    # by design, see stop_host)
+                    for host in self.hosts:
+                        self.stop_host(host)
+                    rc = 0
+                    for pid, host in enumerate(self.hosts):
+                        rc_host = self.sync_host(host)
+                        if rc_host == 0:
+                            rc_host = self.launch_host(
+                                pid, session=session)
+                        rc |= rc_host
+                    relaunched.append(
+                        (tuple(r for r, _ in dead), session))
+                    # grace runs from wave END: a slow multi-host
+                    # rsync must not eat the new incarnation's
+                    # startup window
+                    last_wave = time.monotonic()
+                    if rc != 0 and out is not None:
+                        out(f"[supervise] relaunch wave {session} "
+                            f"returned rc={rc}; next poll retries")
+                polls += 1
+                if max_polls is None or polls < max_polls:
+                    time.sleep(interval_s)
+        except KeyboardInterrupt:  # pragma: no cover - operator ^C
+            pass
+        return relaunched
 
     def send(self):
         """sync + launch (the reference's Job.send)."""

@@ -25,9 +25,15 @@ equivalent by design and proves it with deterministic fault injection:
   filesystem rendezvous under ``DK_COORD_DIR``, or trivially local;
   typed :class:`PeerLost` / :class:`BarrierTimeout` instead of hangs,
   heartbeat liveness files for dead-peer attribution.
+- :mod:`~dist_keras_tpu.resilience.supervisor` — the auto-resume loop
+  (``supervise(fn, checkpointer, ...)``): restore from the latest
+  VERIFIED checkpoint on crash or :class:`Preempted`, never retry
+  typed-fatal errors, give up with a typed :class:`CrashLoop` (carrying
+  evidence) when the rolling restart budget dies.
 
-See the README "Failure semantics" section for the retried / resumed /
-fatal taxonomy and the multi-host preemption matrix.
+See the README "Failure semantics" and "Recovery & integrity" sections
+for the retried / resumed / fatal taxonomy, the multi-host preemption
+matrix, and the self-healing (verify / quarantine / supervise) layer.
 """
 
 from dist_keras_tpu.resilience import (
@@ -36,9 +42,11 @@ from dist_keras_tpu.resilience import (
     guards,
     preemption,
     retry,
+    supervisor,
 )
 from dist_keras_tpu.resilience.coordination import (
     BarrierTimeout,
+    CoordinatorPoisoned,
     FileCoordinator,
     PeerLost,
     get_coordinator,
@@ -52,10 +60,18 @@ from dist_keras_tpu.resilience.faults import (
 from dist_keras_tpu.resilience.guards import NonFiniteLossError
 from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.resilience.retry import RetryPolicy, retry_call
+from dist_keras_tpu.resilience.supervisor import (
+    CrashLoop,
+    RestartBudget,
+    supervise,
+)
 
 __all__ = [
     "coordination", "faults", "guards", "preemption", "retry",
-    "BarrierTimeout", "FaultInjected", "FileCoordinator", "PeerLost",
+    "supervisor",
+    "BarrierTimeout", "CoordinatorPoisoned", "CrashLoop",
+    "FaultInjected", "FileCoordinator", "PeerLost", "RestartBudget",
     "armed", "fault_point", "get_coordinator", "inject",
     "NonFiniteLossError", "Preempted", "RetryPolicy", "retry_call",
+    "supervise",
 ]

@@ -87,6 +87,17 @@ class PeerLost(RuntimeError):
         self.ranks = tuple(ranks)
 
 
+class CoordinatorPoisoned(RuntimeError):
+    """A collective was attempted on a coordinator whose op stream
+    already desynced (a previous collective timed out).  TYPED and
+    FATAL-by-design: the process's position in the cluster's op stream
+    is unknowable, so no retry can help — the auto-resume supervisor
+    (``resilience.supervisor``) classifies this as never-retried and
+    the process must be restarted as a fresh incarnation (rotating
+    ``DK_COORD_SESSION``).  Subclasses ``RuntimeError`` so pre-existing
+    catch sites keep working."""
+
+
 def with_deadline(fn, timeout_s, what, stale_probe=None):
     """Run ``fn()`` but give up after ``timeout_s`` seconds: raises
     :class:`PeerLost` (when ``stale_probe()`` names ranks with
@@ -278,7 +289,7 @@ class Coordinator:
         from dist_keras_tpu.observability import events
 
         if self._poisoned:
-            raise RuntimeError(
+            raise CoordinatorPoisoned(
                 "coordinator is poisoned: a previous collective timed "
                 f"out ({self._poisoned}) and this process's position "
                 "in the cluster's op stream is unknowable — restart "
@@ -605,7 +616,7 @@ def world():
 
 
 def dead_peers_at(coord_dir, world, stale_after_s=None,
-                  require_file=False):
+                  require_file=False, session=None):
     """Public launcher/monitor-side probe: dead ranks for a job's
     ``coord_dir`` as configured (session subdir and ``~`` resolved the
     same way the workers resolve them) — the stable surface for
@@ -614,11 +625,19 @@ def dead_peers_at(coord_dir, world, stale_after_s=None,
     window honors ``DK_COORD_STALE_S`` so launcher and workers judge
     liveness by the SAME clock; ``require_file=True`` restricts the
     verdict to heartbeat evidence (beat once, went dark), which is
-    what PeerLost-raising callers must use."""
+    what PeerLost-raising callers must use.  ``session`` overrides the
+    ``DK_COORD_SESSION`` env resolution: a launcher-side supervisor
+    that relaunched the pod under a rotated session must judge the NEW
+    incarnation's heartbeats, not its own (session-less) environment's
+    view of the old ones."""
     if stale_after_s is None:
         stale_after_s = float(os.environ.get("DK_COORD_STALE_S", "10"))
-    return dead_peers(_session_root(str(coord_dir)), world,
-                      stale_after_s=stale_after_s,
+    if session is None:
+        root = _session_root(str(coord_dir))
+    else:
+        root = os.path.join(os.path.expanduser(str(coord_dir)),
+                            str(session))
+    return dead_peers(root, world, stale_after_s=stale_after_s,
                       require_file=require_file)
 
 

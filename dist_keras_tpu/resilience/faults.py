@@ -49,6 +49,20 @@ Grammar per semicolon-separated entry: ``point[@at][xN][:k=v,...]`` with
 keys ``action`` (raise|corrupt|replace), ``exc`` (FaultInjected, OSError,
 IOError, ValueError, RuntimeError, ConnectionError, TimeoutError) and
 ``value`` (float for replace).
+
+CHAOS MODE (this PR): ``DK_FAULTS_SEED=<int>`` arms every registered
+fault point (:data:`KNOWN_POINTS`) with a SEEDED random schedule —
+each point independently fires (probability ``DK_FAULTS_RATE``, default
+0.25) at a random call index within ``DK_FAULTS_HORIZON`` (default 20)
+with a seeded choice between a permanent :class:`FaultInjected` and a
+retryable ``OSError``.  The schedule is a pure function of the seed
+(one PRNG, every draw taken whether or not the point arms), so a chaos
+run that breaks replays EXACTLY from its seed — randomized coverage
+with deterministic reproduction.  ``DK_FAULTS_POINTS=a,b`` restricts
+the armed set; explicit ``DK_FAULTS`` entries compose on top.
+``gates.py --chaos-only`` drives K seeded 2-process runs and asserts
+the single self-healing invariant: completed or typed error, with the
+latest promoted checkpoint verifying and restoring bit-equal.
 """
 
 from __future__ import annotations
@@ -83,6 +97,18 @@ _lock = threading.RLock()
 _specs = {}       # point name -> [FaultSpec]
 _counts = {}      # point name -> calls so far
 _env_loaded = False
+
+# Every named fault point in the framework — the registry chaos mode
+# arms.  Adding a fault_point call site?  List it here or the chaos
+# gate can never exercise it.  (Grouped by seam; names are the ones
+# passed to fault_point at each call site.)
+KNOWN_POINTS = (
+    "checkpoint.save", "checkpoint.commit", "coord.commit",
+    "coord.flag", "coord.agree", "coord.barrier",
+    "job.rsync", "job.ssh", "job.heartbeat",
+    "punchcard.read_manifest", "stream.fetch", "step.loss",
+    "serve.enqueue", "serve.predict", "serve.reload",
+)
 
 
 class FaultSpec:
@@ -216,10 +242,84 @@ def _parse_env_entry(entry):
                      value=value)
 
 
+def chaos_schedule(seed, rate=0.25, horizon=20, points=None):
+    """Build (without arming) the seeded chaos schedule: a list of
+    :class:`FaultSpec`, one per point that drew a firing.
+
+    A PURE function of ``(seed, rate, horizon, points)``: the PRNG
+    draws the SAME sequence for every point whether or not it arms
+    (fire/at/exc consumed unconditionally), so tightening ``rate``
+    never reshuffles which call index a still-armed point fires at —
+    a chaos failure reproduces from its seed alone.  Each armed point
+    fires once, at a uniform call index in ``[0, horizon)``, raising
+    either a permanent :class:`FaultInjected` (simulated kill) or a
+    retryable ``OSError`` (transient to absorb) — seeded coin flip.
+    """
+    import random as _random
+
+    rate = float(rate)
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"chaos rate={rate} must be in [0, 1]")
+    horizon = int(horizon)
+    if horizon < 1:
+        raise ValueError(f"chaos horizon={horizon} must be >= 1")
+    rng = _random.Random(int(seed))
+    specs = []
+    for point in (KNOWN_POINTS if points is None else tuple(points)):
+        fire = rng.random() < rate
+        at = rng.randrange(horizon)
+        transient = rng.random() < 0.5
+        if fire:
+            specs.append(FaultSpec(
+                point, at=at,
+                exc=OSError if transient else FaultInjected))
+    return specs
+
+
+def _load_chaos_env():
+    """Arm the ``DK_FAULTS_SEED`` chaos schedule (under _lock, from
+    load_env).  Malformed knobs fail LOUDLY at load time, like
+    DK_FAULTS entries."""
+    seed = os.environ.get("DK_FAULTS_SEED", "").strip()
+    if not seed:
+        return
+    try:
+        seed = int(seed)
+    except ValueError:
+        raise ValueError(
+            f"malformed DK_FAULTS_SEED {seed!r}: expected an integer")
+    rate = os.environ.get("DK_FAULTS_RATE", "0.25").strip() or "0.25"
+    try:
+        rate = float(rate)
+    except ValueError:
+        raise ValueError(
+            f"malformed DK_FAULTS_RATE {rate!r}: expected a float")
+    horizon = os.environ.get("DK_FAULTS_HORIZON", "20").strip() or "20"
+    try:
+        horizon = int(horizon)
+    except ValueError:
+        raise ValueError(
+            f"malformed DK_FAULTS_HORIZON {horizon!r}: expected an int")
+    points = None
+    raw_points = os.environ.get("DK_FAULTS_POINTS", "").strip()
+    if raw_points:
+        points = tuple(p.strip() for p in raw_points.split(",")
+                       if p.strip())
+        unknown = sorted(set(points) - set(KNOWN_POINTS))
+        if unknown:
+            raise ValueError(
+                f"DK_FAULTS_POINTS names unknown fault point(s) "
+                f"{unknown}; known: {sorted(KNOWN_POINTS)}")
+    for spec in chaos_schedule(seed, rate=rate, horizon=horizon,
+                               points=points):
+        _specs.setdefault(spec.point, []).append(spec)
+
+
 def load_env(var="DK_FAULTS", force=False):
-    """Arm the schedule in ``$DK_FAULTS`` (idempotent per process; called
-    lazily by the first :func:`fault_point`; ``force=True`` re-reads the
-    env after a :func:`clear`)."""
+    """Arm the schedule in ``$DK_FAULTS`` plus the seeded chaos
+    schedule in ``$DK_FAULTS_SEED`` (idempotent per process; called
+    lazily by the first :func:`fault_point`; ``force=True`` re-reads
+    the env after a :func:`clear`)."""
     global _env_loaded
     with _lock:
         if _env_loaded and not force:
@@ -229,6 +329,7 @@ def load_env(var="DK_FAULTS", force=False):
             spec = _parse_env_entry(entry)
             if spec is not None:
                 _specs.setdefault(spec.point, []).append(spec)
+        _load_chaos_env()
 
 
 def _corrupt(value):

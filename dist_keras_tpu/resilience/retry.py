@@ -66,6 +66,31 @@ class RetryPolicy:
         import os
 
         self._rng = random.Random(os.getpid() if seed is None else seed)
+        self._deadline = None  # armed by call()/start_deadline()
+
+    def start_deadline(self):
+        """Arm the overall ``timeout`` deadline from NOW.  :meth:`call`
+        does this itself; a caller driving its OWN loop (the auto-resume
+        supervisor) arms it once and then consults
+        :meth:`remaining_deadline` so every nested retry surface shares
+        one budget.  -> the remaining seconds (None = unbounded)."""
+        self._deadline = (None if self.timeout is None
+                          else self.clock() + self.timeout)
+        return self.timeout
+
+    def remaining_deadline(self):
+        """Seconds left in the overall ``timeout`` budget of the current
+        (or most recent) :meth:`call` / :meth:`start_deadline`, clipped
+        at 0.0; None when the policy has no timeout.  Before any call
+        the FULL budget is reported — a nested surface asking early must
+        not read "already expired".  This is how an outer budget (the
+        supervisor's) bounds inner retries (a checkpoint save's) instead
+        of the two silently stacking."""
+        if self.timeout is None:
+            return None
+        if self._deadline is None:
+            return self.timeout
+        return max(0.0, self._deadline - self.clock())
 
     def delay(self, attempt):
         """Backoff before retry ``attempt`` (1-based), jitter applied."""
@@ -84,8 +109,8 @@ class RetryPolicy:
         from dist_keras_tpu.observability import events, metrics
 
         surface = self.name or "retry"
-        deadline = (None if self.timeout is None
-                    else self.clock() + self.timeout)
+        self.start_deadline()
+        deadline = self._deadline
         last = None
         for attempt in range(1, self.attempts + 1):
             try:

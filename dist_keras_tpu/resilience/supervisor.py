@@ -1,0 +1,200 @@
+"""Auto-resume supervisor — "typed exit" becomes "resumed run".
+
+The resilience stack below this module guarantees a crash or preemption
+leaves a fully-committed, integrity-manifested checkpoint; what it did
+NOT do is relaunch anything — the operator had to notice the 143 and
+restart by hand.  :func:`supervise` closes that loop in the
+torchelastic style: run a training callable under a restart budget,
+restore from the latest *verified* step
+(``Checkpointer.latest_verified_step`` — a corrupt latest step is
+skipped, the run resumes one cadence earlier instead of crash-looping
+against unreadable bytes), and give up TYPED when restarting stops
+being a plan:
+
+- **Fatal errors are never retried.**  Config mistakes (``ValueError``
+  / ``TypeError``) and a poisoned coordinator
+  (:class:`~dist_keras_tpu.resilience.coordination.CoordinatorPoisoned`
+  — the collective stream desynced; only a fresh incarnation helps)
+  propagate immediately.  Restarting a run that cannot ever succeed
+  just burns the cluster.
+- **A crash loop is a typed verdict, not an infinite loop.**  More than
+  ``max_restarts`` restarts inside a rolling ``budget_window_s`` raises
+  :class:`CrashLoop` carrying the evidence (timestamp + error of every
+  restart in the window) — the post-mortem is in the exception, not
+  scattered across N logs.
+- **One deadline bounds everything.**  ``deadline_s`` arms the
+  supervisor's :class:`~dist_keras_tpu.resilience.retry.RetryPolicy`
+  deadline; backoff sleeps are clipped to
+  ``policy.remaining_deadline()`` and nested retry surfaces (a
+  checkpoint save's own policy) can consult the same number, so inner
+  retries can't silently overrun the outer budget.
+
+``Preempted`` (SIGTERM → boundary checkpoint → ``SystemExit``) counts
+as restartable: the per-process preemption flag is cleared before the
+relaunch, and the next attempt resumes from the very step the
+coordinated exit committed.  Events: ``supervisor_restart`` per
+relaunch, ``supervisor_giveup`` (reason = fatal | crash_loop |
+deadline) when the supervisor stops.
+
+Launcher-side, ``launch.Job(supervise=...)`` reuses
+:class:`RestartBudget` to relaunch DEAD HOSTS (heartbeat-proven via
+``dead_hosts()``) over the existing rsync/ssh retry surfaces, rotating
+``DK_COORD_SESSION`` per incarnation so the FileCoordinator rendezvous
+never mixes two attempts' markers.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from dist_keras_tpu.resilience.preemption import Preempted
+from dist_keras_tpu.resilience.retry import RetryPolicy
+
+
+class CrashLoop(RuntimeError):
+    """The restart budget died: ``len(evidence)`` failures inside the
+    rolling window (or the overall deadline expired).  ``evidence`` is
+    ``[(t_monotonic, exc_type_name, detail), ...]`` for every failure
+    still inside the window — the give-up carries its own post-mortem.
+    """
+
+    def __init__(self, msg, evidence=(), reason="crash_loop"):
+        self.evidence = list(evidence)
+        self.reason = reason
+        super().__init__(msg)
+
+
+class RestartBudget:
+    """N restarts per rolling window — the shared budget arithmetic of
+    :func:`supervise` (in-process restarts) and ``Job.supervise_run``
+    (dead-host relaunches).  :meth:`record` returns True while the
+    budget lives; the first recording that overflows the window returns
+    False and :attr:`evidence` holds the window's failures."""
+
+    def __init__(self, max_restarts, window_s, clock=time.monotonic):
+        if int(max_restarts) < 0:
+            raise ValueError(
+                f"max_restarts={max_restarts} must be >= 0")
+        if float(window_s) <= 0:
+            raise ValueError(f"budget window {window_s}s must be > 0")
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._events = deque()
+
+    def record(self, error_name, detail=""):
+        """Record one failure; -> True if a restart is still in budget."""
+        now = self.clock()
+        self._events.append((now, str(error_name), str(detail)[:200]))
+        while self._events and now - self._events[0][0] > self.window_s:
+            self._events.popleft()
+        return len(self._events) <= self.max_restarts
+
+    @property
+    def evidence(self):
+        return list(self._events)
+
+
+# Never retried: a restart cannot fix a bad config or a desynced
+# collective stream.  (CoordinatorPoisoned is resolved lazily to keep
+# this module import-light; it subclasses RuntimeError, so it must be
+# tested BEFORE the generic handler.)
+def _default_fatal():
+    from dist_keras_tpu.resilience.coordination import CoordinatorPoisoned
+
+    return (ValueError, TypeError, CoordinatorPoisoned, CrashLoop)
+
+
+def supervise(fn, checkpointer=None, *, max_restarts=3,
+              budget_window_s=300.0, backoff=0.5, multiplier=2.0,
+              max_delay=30.0, deadline_s=None, fatal=None,
+              sleep=time.sleep, clock=time.monotonic, on_restart=None):
+    """Run ``fn`` under the auto-resume restart loop; -> ``fn``'s
+    return value from the attempt that completed.
+
+    ``fn(attempt, resume_step)`` is the training callable: ``attempt``
+    is 0 for the first run and counts restarts; ``resume_step`` is the
+    latest VERIFIED checkpoint step (None without a ``checkpointer`` or
+    before any save) — pass it into the trainer's ``resume=`` so the
+    relaunch continues from the agreed chunk instead of epoch 0
+    (``Trainer(resume=resume_step if resume_step is not None else
+    False)`` accepts the explicit step).
+
+    Restarted: :class:`Preempted` (the flag is cleared first — a
+    restart in the same process must not instantly re-preempt) and any
+    ``Exception`` outside ``fatal``.  ``fatal`` defaults to
+    ``(ValueError, TypeError, CoordinatorPoisoned, CrashLoop)``.
+    Budget: ``max_restarts`` per rolling ``budget_window_s`` —
+    exceeded, a typed :class:`CrashLoop` (with the window's evidence)
+    chains from the last error.  ``deadline_s`` additionally bounds the
+    WHOLE supervised run (sleeps clipped, no restart starts past it);
+    the supervisor's policy deadline is shared with nested surfaces via
+    ``RetryPolicy.remaining_deadline``.
+    """
+    from dist_keras_tpu.observability import events, metrics
+    from dist_keras_tpu.resilience import preemption
+
+    fatal = _default_fatal() if fatal is None else tuple(fatal)
+    budget = RestartBudget(max_restarts, budget_window_s, clock=clock)
+    policy = RetryPolicy(attempts=max_restarts + 1, backoff=backoff,
+                         multiplier=multiplier, max_delay=max_delay,
+                         timeout=deadline_s, jitter=0.0,
+                         sleep=sleep, clock=clock, name="supervisor")
+    policy.start_deadline()
+    attempt = 0
+    while True:
+        try:
+            # the probe lives INSIDE the try: a transient OSError from
+            # a flaky checkpoint dir (all_steps' listdir) is exactly
+            # the class this loop absorbs — raised here it is budgeted
+            # and retried like the same error out of fn itself
+            resume_step = (checkpointer.latest_verified_step()
+                           if checkpointer is not None else None)
+            return fn(attempt, resume_step)
+        except fatal as e:
+            events.emit("supervisor_giveup", reason="fatal",
+                        attempt=attempt, error=type(e).__name__,
+                        detail=str(e)[:200])
+            raise
+        except (Exception, Preempted) as e:
+            if isinstance(e, Preempted):
+                # the per-process flag survives the exception; left
+                # set, the relaunched trainer would vote preempt at
+                # its FIRST boundary and exit again — a fake crash loop
+                preemption.clear()
+            in_budget = budget.record(type(e).__name__, str(e))
+            remaining = policy.remaining_deadline()
+            if not in_budget or (remaining is not None
+                                 and remaining <= 0):
+                reason = "crash_loop" if not in_budget else "deadline"
+                events.emit("supervisor_giveup", reason=reason,
+                            attempt=attempt, error=type(e).__name__,
+                            restarts_in_window=len(budget.evidence),
+                            window_s=budget.window_s)
+                metrics.counter("supervisor.giveups").inc()
+                lines = "; ".join(
+                    f"+{t - budget.evidence[0][0]:.1f}s {name}: {detail}"
+                    for t, name, detail in budget.evidence)
+                raise CrashLoop(
+                    f"supervisor giving up ({reason}): "
+                    f"{len(budget.evidence)} failure(s) in the last "
+                    f"{budget.window_s:.0f}s (budget: {max_restarts} "
+                    f"restarts"
+                    + (f", deadline {deadline_s:.0f}s"
+                       if deadline_s is not None else "")
+                    + f") — {lines}",
+                    evidence=budget.evidence, reason=reason) from e
+            attempt += 1
+            d = policy.delay(attempt)
+            if remaining is not None:
+                d = min(d, remaining)
+            events.emit("supervisor_restart", attempt=attempt,
+                        error=type(e).__name__, detail=str(e)[:200],
+                        delay_s=d,
+                        preempted=isinstance(e, Preempted))
+            metrics.counter("supervisor.restarts").inc()
+            if on_restart is not None:
+                on_restart(attempt, e, d)
+            if d > 0:
+                sleep(d)

@@ -22,6 +22,19 @@ Failure semantics (the serving third of the resilience story):
   ``serve_reload_error`` event + ``serve.reload.errors`` counter,
   keeps serving the OLD params, and keeps watching — a bad checkpoint
   must never take the fleet down or hang it.
+- Every candidate step is INTEGRITY-VERIFIED before the swap
+  (``Checkpointer.verify`` — a strictly read-only probe of the
+  manifest hashes): a corrupt promoted step is SKIPPED with a typed
+  ``reload_skipped_corrupt`` event + ``serve.reload.skipped_corrupt``
+  counter and the NEWEST verifiable newer step is loaded instead
+  (none at all: the engine keeps serving the old params; previously a
+  rotted step would fail inside the restore mid-swap attempt and burn
+  the reload loop's whole retry budget each poll).  The restore
+  itself runs ``verify=False`` — the probe already passed, and the
+  verified-restore path would quarantine (rename) inside the
+  trainer's live directory, which a reader must never do.  A legacy
+  pre-manifest checkpoint verifies "unverifiable" and reloads as
+  before.
 """
 
 from __future__ import annotations
@@ -66,33 +79,97 @@ class CheckpointWatcher:
                           if initial_step is None else int(initial_step))
         self.reloads = 0
         self.errors = 0
+        self.skipped_corrupt = 0
+        # steps already convicted corrupt but not yet folded into
+        # last_step (a restore failure on the chosen INTACT step keeps
+        # last_step put so the restore is retried next poll — without
+        # this set each such poll would re-hash the corrupt steps'
+        # whole payloads and re-emit reload_skipped_corrupt for them)
+        self._corrupt_seen = set()
         self._stop = threading.Event()
         self._thread = None
 
     def poll_once(self):
         """Check for a newer promoted step; reload it into the engine.
 
-        -> the step reloaded, or None when nothing new.  Raises the
-        (typed) reload error to a direct caller — the background loop
-        is the path that absorbs it."""
+        -> the step reloaded (the NEWEST verifiable step newer than
+        ``last_step`` — a rotted latest falls back to an intact
+        intermediate promotion), or None when nothing new OR every
+        newer step failed integrity verification (skipped, typed
+        ``reload_skipped_corrupt`` event per corrupt step, old params
+        kept — for both direct callers and the background loop; a
+        rotted promoted step is an expected hazard of watching a live
+        training directory, not an exception for every caller to
+        re-handle).  Raises the (typed) reload error to a direct
+        caller — the background loop is the path that absorbs it."""
+        from dist_keras_tpu.checkpoint import CheckpointCorrupt
+
         # timeout_s=0 = a single non-blocking probe of the promoted
         # steps; the BLOCKING wait stays in wait_for_step_after for
         # direct callers, while this loop keeps its own stoppable
         # cadence (self._stop.wait between probes)
-        step = self.checkpointer.wait_for_step_after(
+        newest = self.checkpointer.wait_for_step_after(
             step=self.last_step, timeout_s=0)
+        if newest is None:
+            return None
+        # newest-first over EVERY promoted step newer than last_step:
+        # a rotted latest must not shadow an intact intermediate
+        # promotion (trainer promotes 5 then 6, 6 rots between polls —
+        # serving step-4 params until step 7 lands would be one full
+        # cadence of staleness the directory already has the cure for)
+        candidates = [s for s in self.checkpointer.all_steps()
+                      if s > (self.last_step or 0)] or [newest]
+        step = None
+        for cand in reversed(candidates):
+            if cand in self._corrupt_seen:
+                continue  # convicted on an earlier poll: dead bytes
+            try:
+                # read-only probe (never quarantines — this process is
+                # a reader of someone else's training directory); "ok"
+                # and the legacy "unverifiable" both proceed to the swap
+                self.checkpointer.verify(cand)
+                step = cand
+                break
+            except CheckpointCorrupt as e:
+                self._corrupt_seen.add(cand)
+                self.skipped_corrupt += 1
+                metrics.counter("serve.reload.skipped_corrupt").inc()
+                events.emit("reload_skipped_corrupt", step=int(cand),
+                            detail=str(e)[:200])
+        # every newer step is now seen — loaded, or skipped as corrupt
+        # bytes that cannot heal (hot-looping verification against
+        # them would melt the poll loop; the trainer's NEXT promotion
+        # supersedes them)
         if step is None:
+            self._advance(max(candidates))
             return None
         with span("serve.reload", step=step):
             def attempt():
                 fault_point("serve.reload")
+                # verify=False: the read-only probe above already ran.
+                # The default VERIFIED restore would, if the step rots
+                # between probe and read, QUARANTINE it (a rename in
+                # the trainer's live directory this reader must never
+                # perform) and silently fall back — the engine would
+                # then serve step-N-1 params stamped as step N.  With
+                # verification pinned off the race window collapses to
+                # a typed load error, absorbed like any reload failure.
                 return self.checkpointer.restore(
-                    step=step, template=self.template)
-            _, state = self._retry.call(attempt)
-            self.engine.set_params(state, step=step)
-        self.last_step = step
+                    step=step, template=self.template, verify=False)
+            got, state = self._retry.call(attempt)
+            self.engine.set_params(state, step=got)
+        # max, not step: a corrupt candidate NEWER than the one loaded
+        # is seen too, or the next poll would re-verify dead bytes
+        self._advance(max(candidates))
         self.reloads += 1
         return step
+
+    def _advance(self, step):
+        self.last_step = step
+        # convictions at or below the new horizon are subsumed by
+        # last_step; the set only ever holds the (bounded) window of
+        # corrupt steps newer than an intact one still being retried
+        self._corrupt_seen = {s for s in self._corrupt_seen if s > step}
 
     def _loop(self):
         while not self._stop.is_set():

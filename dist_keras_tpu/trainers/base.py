@@ -63,7 +63,18 @@ class Trainer:
         if self.checkpoint_dir and self.checkpoint_every is None:
             self.checkpoint_every = 1
         self.max_checkpoints = int(max_checkpoints)
-        self.resume = bool(resume)
+        # resume: False = fresh run; True = continue from the latest
+        # (verified — restore() falls back past a corrupt step) step;
+        # an INT = continue from exactly that step.  The explicit form
+        # is what the auto-resume supervisor passes: its fn receives
+        # the latest VERIFIED step as resume_step and hands it straight
+        # to Trainer(resume=resume_step), so the relaunch provably
+        # consumes the agreed units_done instead of whatever the
+        # directory happens to hold by the time the trainer starts.
+        if isinstance(resume, bool) or resume is None:
+            self.resume = bool(resume)
+        else:
+            self.resume = int(resume)
         self.callbacks = list(callbacks or [])
         # ---- resilience (round 6) ----
         # nan_policy: what the loss sentinel does on NaN/Inf —
@@ -266,18 +277,40 @@ class Trainer:
         raises its own opaque tree error long before a key check on the
         restored dict could run)."""
         ckptr = self._checkpointer_or_none()
-        if not (self.resume and ckptr is not None):
+        # resume=0 is an EXPLICIT step (the supervisor's resume_step can
+        # legitimately be the unit-0 preemption save), so the gate tests
+        # identity against False, not truthiness
+        if self.resume is False or ckptr is None:
             return 0, None
-        if ckptr.latest_step() is None:
+        explicit = None if self.resume is True else int(self.resume)
+        if explicit is None and ckptr.latest_step() is None:
             return 0, None
+        from dist_keras_tpu.checkpoint import CheckpointCorrupt
+
         try:
-            step, state = ckptr.restore(template=template)
+            step, state = ckptr.restore(step=explicit, template=template)
+        except (OSError, CheckpointCorrupt):
+            # NOT wrapped in ValueError: the auto-resume supervisor
+            # classifies ValueError as a never-retried config mistake,
+            # but a transient I/O error is the one failure mode the
+            # self-healing layer exists to absorb, and CheckpointCorrupt
+            # is its typed verdict — laundering either into ValueError
+            # would turn a retryable restart into a permanent giveup
+            raise
         except Exception as e:
             if incompatible_hint:
                 raise ValueError(
                     f"checkpoint restore failed ({type(e).__name__}); "
                     f"{incompatible_hint}") from e
             raise
+        # the RETURNED step is authoritative — a verified fallback may
+        # have restored an earlier step than requested, and the cadence
+        # counter below plus the dispatch start must follow the state
+        # actually loaded, not the step asked for
+        from dist_keras_tpu.observability import events
+
+        events.emit("resume", step=int(step),
+                    requested=explicit, trainer=type(self).__name__)
         self._last_ckpt_epoch = int(step)
         return int(step), state
 

@@ -267,20 +267,33 @@ class ChunkRunner:
             self.tr._checkpointer_or_none().save(units_done, state_fn())
             self.tr._last_ckpt_epoch = units_done
 
-    def _preempt_save(self, units_done, state_fn):
+    def _preempt_save(self, units_done, state_fn, world=1):
         """Boundary checkpoint on a delivered SIGTERM/SIGINT — saved
         regardless of cadence (deduped against a save that already
         landed at this unit), so the restart loses nothing.  The None
         sentinel (vs the 0 default used by the cadence math) matters: a
         fresh run preempted before any save still writes its unit-0
         state, so ``Preempted.saved_step`` never claims a checkpoint
-        that does not exist."""
+        that does not exist.
+
+        The save is VERIFIED before the exit (single-host; on a pod the
+        non-leaders return before the leader's promotion, so there is
+        no committed step for them to probe yet): the whole point of
+        the typed 128+signum exit is that the restart can stand on this
+        exact checkpoint — a torn boundary save must surface as a typed
+        ``CheckpointCorrupt`` NOW, not as a restore explosion in the
+        relaunched incarnation.  ``Preempted.saved_step`` is therefore
+        a *checked* claim.  (Skipped under ``DK_CKPT_VERIFY=0``: no
+        manifest was written, ``verify`` reports a soft
+        "unverifiable".)"""
         ckptr = self.tr._checkpointer_or_none()
         if ckptr is None:
             return None
         if getattr(self.tr, "_last_ckpt_epoch", None) != units_done:
             ckptr.save(units_done, state_fn())
             self.tr._last_ckpt_epoch = units_done
+        if world == 1:
+            ckptr.verify(units_done)
         return units_done
 
     def run(self, dispatch, sync_ref, state_fn, resident_data=()):
@@ -387,7 +400,8 @@ class ChunkRunner:
                         # comes.  Either every host saves or none does.
                         self._halt = coord.any_flag(self._halt)
                     saved = (None if self._halt
-                             else self._preempt_save(units_done, state_fn))
+                             else self._preempt_save(units_done, state_fn,
+                                                     world=coord.world))
                     if coord.world > 1:
                         # every host's save (incl. the leader's
                         # promotion) lands before ANY host exits — the

@@ -49,9 +49,23 @@ the gate, drains through the preemption path with every admitted
 request delivered, rejects afterwards with a typed ``Overloaded``,
 and exits 143).
 
+The CHAOS gate (``--chaos-only``, this PR) is the self-healing
+acceptance: K seeded randomized-fault 2-process FileCoordinator runs
+(``DK_FAULTS_SEED`` arms every registered fault point with a seeded
+random schedule), each asserting the single invariant — the run ends
+in *completed* or *typed error*, AND the latest PROMOTED checkpoint
+verifies against its integrity manifest and restores bit-equal to what
+the worker reported saving; never a hang, never an unreadable latest
+step.  Three deterministic scenarios ride along: a deliberately
+corrupted latest step must be quarantined with ``restore()`` returning
+the previous promoted step; ``supervise()`` must resume a REAL
+SIGTERM'd training run from the agreed chunk; and a crash-looping
+callable must die typed (``CrashLoop``) once the restart budget is
+spent.  Per-run verdicts are recorded into the gates JSON.
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
-                        [--serving-only]
+                        [--serving-only] [--chaos-only]
 """
 
 from __future__ import annotations
@@ -396,6 +410,388 @@ finally:
 from dist_keras_tpu.resilience.preemption import Preempted
 raise Preempted(srv.preempted_signum)
 """
+
+
+# The chaos gate's 2-process worker: the coordinated-preemption
+# choreography (votes, agreements, two-phase saves, barriers) driven
+# for several rounds under a SEEDED random fault schedule
+# (DK_FAULTS_SEED armed by the parent; each rank gets a different seed
+# so failures are asymmetric, like real hardware).  Rank 0 prints the
+# sha256 of its payload after every save that RETURNED — save returns
+# on the leader only after promotion, so every printed line names a
+# step that is promoted and must verify + restore bit-equal.
+_CHAOS_WORKER = r"""
+import os, sys, hashlib
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank, coord_dir, ck_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["DK_COORD_DIR"] = coord_dir
+os.environ["DK_COORD_RANK"] = str(rank)
+os.environ["DK_COORD_WORLD"] = "2"
+os.environ["DK_COORD_TIMEOUT_S"] = "20"
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.resilience import coordination
+
+coord = coordination.get_coordinator()
+ckptr = Checkpointer(ck_dir, commit_timeout_s=20, max_to_keep=3)
+w = np.arange(64, dtype=np.float64) + rank
+for i in range(8):
+    w = w * 1.01 + (i + rank)        # the "training" step
+    coord.any_flag(False)            # the boundary vote
+    if i % 2 == 1:                   # the checkpoint cadence
+        step = coord.agree_min(i)
+        state = {"w": w.copy(), "i": np.int64(i)}
+        ckptr.save(step, state)
+        if rank == 0:
+            print("SAVED", step,
+                  hashlib.sha256(state["w"].tobytes()).hexdigest(),
+                  flush=True)
+        coord.barrier(f"save_{i}")
+print("COMPLETED", rank, flush=True)
+"""
+
+# The self-healing scenario worker (one subprocess per mode):
+#
+# "resume"  — a real training run (SingleTrainer, per-epoch saves)
+#             under supervise(); the PARENT sends SIGTERM mid-run; the
+#             boundary checkpoint + Preempted land, supervise clears
+#             the flag and relaunches IN-PROCESS with
+#             resume=<latest verified step>, and the run completes.
+#             Prints SUPERVISED <attempts> <resume_step>.
+# "giveup"  — a callable that always crashes must exhaust the restart
+#             budget and die with a typed CrashLoop carrying evidence.
+# "corrupt" — save steps 1..3, bit-flip the latest payload, then
+#             truncate another step's manifest: verify() must raise
+#             typed CheckpointCorrupt for both, restore() must fall
+#             back to the intact step and quarantine the bad ones.
+# "check"   — post-mortem verifier for a chaos run's directory: the
+#             latest PROMOTED step must verify "ok" (every host
+#             payload) and restore bit-equal to the sha the worker
+#             printed (passed as a step:sha JSON file).
+_HEAL_WORKER = r"""
+import os, sys, json, time, glob
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %REPO%)
+import hashlib
+import numpy as np
+from dist_keras_tpu.checkpoint import (
+    CheckpointCorrupt, Checkpointer, verify_manifest)
+
+mode, work = sys.argv[1], sys.argv[2]
+
+
+def flip_byte(payload_dir):
+    files = [f for f in glob.glob(os.path.join(payload_dir, "**"),
+                                  recursive=True)
+             if os.path.isfile(f) and not f.endswith("manifest.json")]
+    tgt = max(files, key=os.path.getsize)
+    with open(tgt, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return tgt
+
+
+if mode == "check":
+    saved = json.load(open(sys.argv[3]))  # {"<step>": "<sha256>"}
+    ck = Checkpointer(os.path.join(work, "ck"), rank=0, world=1)
+    latest = ck.latest_step()
+    if latest is None:
+        # nothing ever promoted (fault before the first commit): there
+        # is no claim to verify — but the worker must not have printed
+        # a SAVED line either
+        print("CHECK_OK none" if not saved else
+              "CHECK_BAD promoted steps vanished", flush=True)
+        sys.exit(0 if not saved else 1)
+    step_dir = os.path.join(work, "ck", f"step_{latest:08d}")
+    hosts = sorted(glob.glob(os.path.join(step_dir, "host_*")))
+    bad = []
+    for payload in (hosts or [step_dir]):
+        status, problems = verify_manifest(payload)
+        if status != "ok":
+            bad.append(f"{os.path.basename(payload)}: {status} "
+                       f"{problems[:2]}")
+    if str(latest) not in saved:
+        bad.append(f"promoted step {latest} was never reported saved")
+    else:
+        step, st = ck.restore(step=latest)
+        sha = hashlib.sha256(
+            np.asarray(st["w"], dtype=np.float64).tobytes()).hexdigest()
+        if step != latest:
+            bad.append(f"restore({latest}) fell back to {step}")
+        elif sha != saved[str(latest)]:
+            bad.append(f"step {latest} restored sha {sha[:12]} != "
+                       f"saved {saved[str(latest)][:12]}")
+    print(("CHECK_OK " + str(latest)) if not bad else
+          ("CHECK_BAD " + "; ".join(bad)), flush=True)
+    sys.exit(0 if not bad else 1)
+
+if mode == "corrupt":
+    ck = Checkpointer(os.path.join(work, "ck"), rank=0, world=1,
+                      max_to_keep=10)
+    w1 = np.arange(128, dtype=np.float64)
+    ck.save(1, {"w": w1})
+    ck.save(2, {"w": w1 * 3})
+    ck.save(3, {"w": w1 * 7})
+    bad = []
+    # (a) bit-flipped payload on the latest step
+    flip_byte(os.path.join(work, "ck", "step_00000003"))
+    try:
+        ck.verify(3)
+        bad.append("verify(3) passed on a bit-flipped payload")
+    except CheckpointCorrupt:
+        pass
+    step, st = ck.restore()
+    if step != 2 or not np.array_equal(np.asarray(st["w"]), w1 * 3):
+        bad.append(f"restore fell back to {step}, not intact step 2")
+    if not os.path.isdir(os.path.join(work, "ck",
+                                      "step_00000003.corrupt")):
+        bad.append("bad step 3 was not quarantined to .corrupt")
+    # (b) the MANIFEST itself rots on the (new) latest step
+    with open(os.path.join(work, "ck", "step_00000002",
+                           "manifest.json"), "w") as f:
+        f.write('{"files": {"truncated')
+    try:
+        ck.verify(2)
+        bad.append("verify(2) passed on a truncated manifest")
+    except CheckpointCorrupt:
+        pass
+    step, st = ck.restore()
+    if step != 1 or not np.array_equal(np.asarray(st["w"]), w1):
+        bad.append(f"manifest-rot restore fell back to {step}, not 1")
+    # (c) a LEGACY (pre-manifest) checkpoint stays restorable: soft
+    # "unverifiable", never a corruption verdict
+    os.remove(os.path.join(work, "ck", "step_00000001",
+                           "manifest.json"))
+    if ck.verify(1) != "unverifiable":
+        bad.append("legacy checkpoint did not verify 'unverifiable'")
+    step, _ = ck.restore()
+    if step != 1:
+        bad.append(f"legacy restore returned {step}")
+    print(("CORRUPT_OK" if not bad else "CORRUPT_BAD " +
+           "; ".join(bad)), flush=True)
+    sys.exit(0 if not bad else 1)
+
+if mode == "giveup":
+    from dist_keras_tpu.resilience.supervisor import CrashLoop, supervise
+
+    def boom(attempt, resume_step):
+        raise OSError(f"boom attempt={attempt}")
+
+    try:
+        supervise(boom, max_restarts=2, backoff=0.0,
+                  budget_window_s=60.0)
+        print("NO_CRASHLOOP", flush=True)
+        sys.exit(1)
+    except CrashLoop as e:
+        ok = len(e.evidence) == 3 and e.reason == "crash_loop"
+        print("CRASHLOOP", len(e.evidence), e.reason, flush=True)
+        sys.exit(0 if ok else 1)
+
+# mode == "resume"
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.resilience.supervisor import supervise
+from dist_keras_tpu.trainers import SingleTrainer
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+n = 256
+y = rng.integers(0, 2, n)
+ds = Dataset({"features": rng.normal(size=(n, 32)).astype(np.float32),
+              "label": y, "label_encoded": one_hot(y, 2)})
+ck_dir = os.path.join(work, "ck")
+ckptr = Checkpointer(ck_dir, rank=0, world=1)
+attempts = []
+
+
+def pacing_cb(tr, epoch, logs):
+    # stretch the run so the parent's SIGTERM lands mid-training, and
+    # publish readiness once the first boundary save exists
+    if epoch >= 2 and not os.path.exists(os.path.join(work, "ready")):
+        with open(os.path.join(work, "ready"), "w") as f:
+            f.write(str(os.getpid()))
+    time.sleep(0.05)
+
+
+def run(attempt, resume_step):
+    attempts.append((attempt, resume_step))
+    t = SingleTrainer(
+        mnist_mlp(hidden=(64,), input_dim=32, num_classes=2),
+        batch_size=32, num_epoch=60, label_col="label_encoded",
+        checkpoint_dir=ck_dir, checkpoint_every=1,
+        resume=(resume_step if resume_step is not None else False),
+        handle_preemption=True, seed=0, callbacks=[pacing_cb])
+    t.train(ds)
+    return t
+
+t = supervise(run, ckptr, max_restarts=3, backoff=0.0,
+              budget_window_s=120.0)
+resumed_from = attempts[-1][1]
+ok = (len(attempts) == 2 and isinstance(resumed_from, int)
+      and resumed_from > 0
+      and t.metrics and t.metrics[-1]["epoch"] == 60)
+print("SUPERVISED", len(attempts), resumed_from, flush=True)
+sys.exit(0 if ok else 1)
+"""
+
+# typed terminal states a chaos worker may die in (matched against the
+# traceback tail): anything else is an UNTYPED death and fails the gate
+_CHAOS_TYPED = ("FaultInjected", "PeerLost", "BarrierTimeout",
+                "OSError", "CoordinatorPoisoned", "CheckpointCorrupt",
+                "CrashLoop", "COMPLETED")
+
+
+def run_chaos_gate(k=8, timeout=150):
+    """-> gate record for the self-healing chaos gate (see the module
+    docstring).  ``runs`` carries every seeded run's verdict so the
+    gates JSON records WHICH schedules were exercised."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_chaos_gate_")
+    chaos_script = os.path.join(work, "chaos_worker.py")
+    heal_script = os.path.join(work, "heal_worker.py")
+    with open(chaos_script, "w") as f:
+        f.write(_CHAOS_WORKER.replace("%REPO%", repr(REPO)))
+    with open(heal_script, "w") as f:
+        f.write(_HEAL_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {kk: v for kk, v in os.environ.items()
+                if not kk.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                      "DK_CKPT"))
+                and kk not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    runs = []
+    scenarios = {}
+    t0 = time.time()
+
+    def _heal(mode, subdir, *extra, sig_after_ready=None):
+        """Run the heal worker; -> (rc, out)."""
+        wdir = os.path.join(work, subdir)
+        os.makedirs(wdir, exist_ok=True)
+        p = subprocess.Popen(
+            [sys.executable, heal_script, mode, wdir, *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=dict(base_env), text=True)
+        if sig_after_ready:
+            ready = os.path.join(wdir, "ready")
+            t_wait = time.time()
+            while not os.path.exists(ready) and p.poll() is None \
+                    and time.time() - t_wait < timeout:
+                time.sleep(0.02)
+            if os.path.exists(ready):
+                p.send_signal(sig_after_ready)
+        try:
+            out = p.communicate(timeout=timeout)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            return -9, "HANG: " + p.communicate()[0][-300:]
+        return p.returncode, out
+
+    try:
+        # --- K seeded randomized-fault runs -------------------------
+        for seed in range(k):
+            run_dir = os.path.join(work, f"seed_{seed}")
+            coord_dir = os.path.join(run_dir, "coord")
+            ck_dir = os.path.join(run_dir, "ck")
+            procs = []
+            for rank in (0, 1):
+                env = dict(base_env)
+                # per-rank seeds: failures land asymmetrically, like
+                # real hardware — and every schedule replays exactly
+                env["DK_FAULTS_SEED"] = str(1000 + seed * 2 + rank)
+                procs.append(subprocess.Popen(
+                    [sys.executable, chaos_script, str(rank),
+                     coord_dir, ck_dir],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    env=env, text=True))
+            outs, hung = [], False
+            for p in procs:
+                try:
+                    outs.append(p.communicate(timeout=timeout)[0])
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs.append(p.communicate()[0])
+                    hung = True
+            rcs = [p.returncode for p in procs]
+            verdict = {"seed": seed, "rcs": rcs, "hung": hung}
+            if hung:
+                failures.append(f"seed {seed}: HANG (killed at "
+                                f"{timeout}s)")
+                runs.append({**verdict, "ok": False})
+                continue
+            for rank, (rc, o) in enumerate(zip(rcs, outs)):
+                if rc == 0 and "COMPLETED" not in o:
+                    failures.append(
+                        f"seed {seed}: rank {rank} exited 0 without "
+                        f"completing: {o[-200:]}")
+                if rc != 0 and not any(tt in o for tt in _CHAOS_TYPED):
+                    failures.append(
+                        f"seed {seed}: rank {rank} died UNTYPED "
+                        f"(rc={rc}): {o[-300:]}")
+            # the invariant's second half: the latest PROMOTED step
+            # verifies and restores bit-equal to what rank 0 reported
+            saved = dict(
+                m.groups() for m in re.finditer(
+                    r"^SAVED (\d+) ([0-9a-f]{64})$", outs[0], re.M))
+            saved_path = os.path.join(run_dir, "saved.json")
+            with open(saved_path, "w") as f:
+                json.dump(saved, f)
+            rc, out = _heal("check", f"seed_{seed}", saved_path)
+            verdict["promoted"] = sorted(int(s) for s in saved)
+            verdict["check"] = out.strip().splitlines()[-1] \
+                if out.strip() else ""
+            if rc != 0 or "CHECK_OK" not in out:
+                failures.append(f"seed {seed}: latest-step check "
+                                f"failed: {out[-300:]}")
+            verdict["ok"] = not any(f.startswith(f"seed {seed}:")
+                                    for f in failures)
+            runs.append(verdict)
+
+        # --- deterministic self-healing scenarios -------------------
+        rc, out = _heal("corrupt", "corrupt")
+        scenarios["corrupt_quarantine"] = out.strip().splitlines()[-1] \
+            if out.strip() else f"rc={rc}"
+        if rc != 0 or "CORRUPT_OK" not in out:
+            failures.append(f"corrupt scenario failed: {out[-300:]}")
+
+        rc, out = _heal("resume", "resume",
+                        sig_after_ready=_signal.SIGTERM)
+        scenarios["supervise_resume"] = out.strip().splitlines()[-1] \
+            if out.strip() else f"rc={rc}"
+        if rc != 0 or "SUPERVISED 2" not in out:
+            failures.append(f"supervise-resume scenario failed "
+                            f"(rc={rc}): {out[-300:]}")
+
+        rc, out = _heal("giveup", "giveup")
+        scenarios["supervise_giveup"] = out.strip().splitlines()[-1] \
+            if out.strip() else f"rc={rc}"
+        if rc != 0 or "CRASHLOOP" not in out:
+            failures.append(f"supervise-giveup scenario failed "
+                            f"(rc={rc}): {out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "chaos_self_healing",
+        "metric": "typed_or_completed_and_latest_verifies_bit_equal",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "k": k,
+        "runs": runs,
+        "scenarios": scenarios,
+        "failures": failures,
+    }
 
 
 def run_serving_gate(timeout=420):
@@ -745,7 +1141,17 @@ def main():
                     help="run just the serving gate (sustained QPS, "
                          "hot reload, SIGTERM drain, serve.* faults, "
                          "retrace bound) and print its record")
+    ap.add_argument("--chaos-only", action="store_true",
+                    help="run just the self-healing chaos gate (K "
+                         "seeded randomized-fault 2-process runs + "
+                         "corruption quarantine + supervise "
+                         "resume/giveup) and print its record")
     args = ap.parse_args()
+
+    if args.chaos_only:
+        chaos_gate = run_chaos_gate()
+        print(json.dumps(chaos_gate, indent=1))
+        return 0 if chaos_gate["passed"] else 1
 
     if args.serving_only:
         serve_gate = run_serving_gate()
@@ -766,6 +1172,7 @@ def main():
     res["gates"].append(coord_gate)
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
+    res["gates"].append(run_chaos_gate())
     import platform
 
     doc = {

@@ -52,6 +52,29 @@ def fast_gates(request):
     return bool(request.config.getoption("--fast"))
 
 
+@pytest.fixture
+def flip_one_byte():
+    """Corruption helper shared by the self-healing tests: bit-flip one
+    byte of the largest non-manifest file under a checkpoint payload
+    dir (largest = the real tensor bytes, not orbax metadata); -> the
+    path flipped."""
+    def _flip(payload_dir):
+        from dist_keras_tpu.checkpoint import MANIFEST_NAME
+
+        files = []
+        for dirpath, _dirs, names in os.walk(str(payload_dir)):
+            files += [os.path.join(dirpath, n) for n in names
+                      if n != MANIFEST_NAME]
+        tgt = max(files, key=os.path.getsize)
+        with open(tgt, "r+b") as f:
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return tgt
+
+    return _flip
+
+
 @pytest.fixture(scope="session")
 def blobs_dataset():
     """Tiny 2-class gaussian-blob classification set, one-hot labels."""

@@ -8,6 +8,8 @@ replicas, optimizer state, staleness counters) and resume to bit-equal
 results after a simulated preemption.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -224,3 +226,89 @@ def test_ensemble_checkpoint_resume(tmp_path):
     control = t3.train(ds)
     for m_r, m_c in zip(resumed, control):
         _weights_close(m_r, m_c)
+
+
+def test_explicit_resume_step_and_verified_fallback(
+        tmp_path, flip_one_byte):
+    """resume=<int> continues from EXACTLY that step (the auto-resume
+    supervisor passes the latest VERIFIED step this way), and a corrupt
+    latest step is healed around: the trainer resumes from the intact
+    previous step, matching a control run resumed from it directly."""
+    import shutil
+
+    import dist_keras_tpu as dk
+
+    ds = _digits_subset()
+    kw = dict(loss="categorical_crossentropy", worker_optimizer="adam",
+              batch_size=16, label_col="label_encoded", seed=3)
+    ckdir = str(tmp_path / "ck")
+    t1 = dk.SingleTrainer(_model(), num_epoch=4, checkpoint_dir=ckdir,
+                          checkpoint_every=2, max_checkpoints=5, **kw)
+    t1.train(ds)
+    # SingleTrainer's checkpoint unit is the optimizer step (32
+    # steps/epoch here): epoch cadence 2 -> saves at steps 64 and 128
+    lo, hi = t1._checkpointer.all_steps()
+
+    # explicit step: resume from the EARLIER save though a newer exists
+    # (each phase-2 run gets its own copy — continuing writes new steps)
+    ck2 = str(tmp_path / "ck2")
+    shutil.copytree(ckdir, ck2)
+    t2 = dk.SingleTrainer(_model(), num_epoch=8, checkpoint_dir=ck2,
+                          checkpoint_every=2, max_checkpoints=5,
+                          resume=lo, **kw)
+    resumed = t2.train(ds)
+    # resumed from lo (epoch 2): the first cadence boundary emitted is
+    # epoch 4 — a resume from hi (epoch 4) would start at 6
+    assert t2.metrics[0]["epoch"] == 4
+
+    # corrupt the latest step: resume=True heals to the earlier save
+    ck3 = str(tmp_path / "ck3")
+    shutil.copytree(ckdir, ck3)
+    flip_one_byte(os.path.join(ck3, f"step_{hi:08d}"))
+    t3 = dk.SingleTrainer(_model(), num_epoch=8, checkpoint_dir=ck3,
+                          checkpoint_every=2, max_checkpoints=5,
+                          resume=True, **kw)
+    healed = t3.train(ds)
+    assert t3.metrics[0]["epoch"] == 4  # fell back past the bad step
+    # the rotted step was quarantined as evidence during the restore
+    assert os.path.isdir(os.path.join(ck3, f"step_{hi:08d}.corrupt"))
+    # same resume point, same lineage: bit-for-bit the same training
+    _weights_close(healed, resumed)
+
+
+def test_resume_restore_errors_stay_typed(
+        tmp_path, flip_one_byte, monkeypatch):
+    """The resume path must NOT launder restore failures into the
+    incompatible-checkpoint ValueError: the auto-resume supervisor
+    never retries ValueError (a config mistake), while CheckpointCorrupt
+    and transient I/O errors are exactly the failures it exists to
+    absorb — wrapping either would turn a retryable restart into a
+    permanent giveup."""
+    import dist_keras_tpu as dk
+    from dist_keras_tpu.checkpoint import CheckpointCorrupt, Checkpointer
+
+    ds = _digits_subset()
+    kw = dict(loss="categorical_crossentropy", worker_optimizer="adam",
+              batch_size=16, label_col="label_encoded", seed=3)
+
+    # corrupt-with-no-fallback: the typed verdict must surface as-is
+    ckdir = str(tmp_path / "ck")
+    Checkpointer(ckdir).save(1, {"w": np.arange(8.0)})
+    flip_one_byte(os.path.join(ckdir, "step_00000001"))
+    t = dk.SingleTrainer(_model(), num_epoch=1, checkpoint_dir=ckdir,
+                         resume=True, **kw)
+    with pytest.raises(CheckpointCorrupt):
+        t.train(ds)
+
+    # transient I/O during restore: propagates as OSError, retryable
+    ck2dir = str(tmp_path / "ck2")
+    Checkpointer(ck2dir).save(1, {"w": np.arange(8.0)})
+
+    def _disk_died(self, step=None, template=None, verify=None):
+        raise OSError("I/O error reading payload")
+
+    monkeypatch.setattr(Checkpointer, "restore", _disk_died)
+    t2 = dk.SingleTrainer(_model(), num_epoch=1, checkpoint_dir=ck2dir,
+                          resume=True, **kw)
+    with pytest.raises(OSError, match="I/O error"):
+        t2.train(ds)

@@ -1,0 +1,848 @@
+"""Self-healing layer: integrity manifests, verified restore fallback,
+the auto-resume supervisor, and the seeded chaos schedule (ISSUE 5).
+
+The resilience stack already guaranteed every crash leaves a COMMITTED
+checkpoint; these tests prove the next layer — that a committed-but-
+rotted checkpoint is detected (typed :class:`CheckpointCorrupt` naming
+the bytes), quarantined (``step_N.corrupt``) and healed around
+(restore falls back to the previous promoted step), and that a typed
+exit becomes a resumed run (``supervise``) under a rolling restart
+budget that gives up TYPED with evidence instead of looping forever.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointCorrupt,
+    Checkpointer,
+    build_manifest,
+    verify_manifest,
+)
+from dist_keras_tpu.resilience import (
+    CrashLoop,
+    FaultInjected,
+    Preempted,
+    RestartBudget,
+    RetryPolicy,
+    faults,
+    preemption,
+    supervise,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    preemption.clear()
+    yield
+    faults.clear()
+    preemption.clear()
+    preemption.restore()
+
+
+def _state(scale=1.0):
+    return {"w": np.arange(32, dtype=np.float64) * scale,
+            "b": np.ones(4, dtype=np.float32)}
+
+
+def _payload(ck, step):
+    return os.path.join(ck.directory, f"step_{step:08d}")
+
+
+# ---------------------------------------------------------------------------
+# manifests: build / verify primitives
+# ---------------------------------------------------------------------------
+def test_save_writes_manifest_that_verifies_ok(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    manifest_path = os.path.join(_payload(ck, 1), MANIFEST_NAME)
+    assert os.path.exists(manifest_path)
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == 1 and manifest["files"]
+    # every listed entry carries bytes + sha256
+    for entry in manifest["files"].values():
+        assert entry["bytes"] > 0 and len(entry["sha256"]) == 64
+    assert ck.verify(1) == "ok"
+    assert verify_manifest(_payload(ck, 1)) == ("ok", [])
+
+
+def test_manifest_tree_digest_covers_membership(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"aaaa")
+    (tmp_path / "b.bin").write_bytes(b"bbbb")
+    m1 = build_manifest(str(tmp_path))
+    # same bytes, one file renamed: per-file hashes overlap but the
+    # tree digest must differ (membership is part of integrity)
+    os.rename(tmp_path / "b.bin", tmp_path / "c.bin")
+    m2 = build_manifest(str(tmp_path))
+    assert m1["tree_sha256"] != m2["tree_sha256"]
+
+
+def test_verify_detects_bit_flip(tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    bad = flip_one_byte(_payload(ck, 1))
+    with pytest.raises(CheckpointCorrupt) as ei:
+        ck.verify(1)
+    # the typed error names the rotted file and the step
+    assert os.path.basename(bad) in str(ei.value)
+    assert ei.value.step == 1 and ei.value.problems
+
+
+def test_verify_detects_truncation_by_size(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    files = [f for f in os.listdir(_payload(ck, 1)) if f != MANIFEST_NAME]
+    tgt = os.path.join(_payload(ck, 1), files[0])
+    with open(tgt, "r+b") as f:
+        f.truncate(max(os.path.getsize(tgt) - 1, 0))
+    with pytest.raises(CheckpointCorrupt) as ei:
+        ck.verify(1)
+    assert "bytes" in "; ".join(ei.value.problems)
+
+
+def test_verify_detects_missing_and_unlisted_files(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    files = sorted(f for f in os.listdir(_payload(ck, 1))
+                   if f != MANIFEST_NAME)
+    os.remove(os.path.join(_payload(ck, 1), files[0]))
+    with open(os.path.join(_payload(ck, 1), "stray.bin"), "wb") as f:
+        f.write(b"not in the manifest")
+    status, problems = verify_manifest(_payload(ck, 1))
+    assert status == "corrupt"
+    joined = "; ".join(problems)
+    assert "listed but missing" in joined
+    assert "present but not in manifest" in joined
+
+
+def test_rotted_manifest_is_itself_corrupt(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    with open(os.path.join(_payload(ck, 1), MANIFEST_NAME), "w") as f:
+        f.write('{"files": {"torn')
+    with pytest.raises(CheckpointCorrupt, match="manifest unreadable"):
+        ck.verify(1)
+
+
+def test_wrong_shape_manifest_is_typed_corrupt(tmp_path):
+    """Valid JSON of the wrong SHAPE (a torn rewrite) stays a typed
+    corruption verdict — leaked untyped out of the comparison walk,
+    supervise() would read the TypeError as a fatal config error
+    instead of healing around the step."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    mpath = os.path.join(_payload(ck, 1), MANIFEST_NAME)
+    for rotted in ('{"files": ["a.bin"]}',
+                   '{"files": {"a.bin": "xx"}}',
+                   '{"files": 3}'):
+        with open(mpath, "w") as f:
+            f.write(rotted)
+        status, problems = verify_manifest(_payload(ck, 1))
+        assert status == "corrupt", rotted
+        assert "manifest unreadable" in problems[0]
+
+
+def test_legacy_checkpoint_is_soft_unverifiable(tmp_path):
+    """A pre-manifest checkpoint (old runs) must keep restoring: verify
+    reports a SOFT "unverifiable", never a corruption verdict."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    state = _state()
+    ck.save(1, state)
+    os.remove(os.path.join(_payload(ck, 1), MANIFEST_NAME))
+    assert ck.verify(1) == "unverifiable"
+    step, restored = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_verify_env_optout_skips_manifest_write(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_VERIFY", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    assert not os.path.exists(os.path.join(_payload(ck, 1), MANIFEST_NAME))
+    # no manifest = legacy semantics: soft unverifiable, restore works
+    assert ck.verify(1) == "unverifiable"
+    assert ck.restore()[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# restore: verified fallback + quarantine
+# ---------------------------------------------------------------------------
+def test_restore_falls_back_past_corrupt_latest_and_quarantines(
+        tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=5)
+    s1, s2, s3 = _state(1.0), _state(3.0), _state(7.0)
+    ck.save(1, s1)
+    ck.save(2, s2)
+    ck.save(3, s3)
+    flip_one_byte(_payload(ck, 3))
+    step, restored = ck.restore()
+    assert step == 2
+    np.testing.assert_array_equal(restored["w"], s2["w"])
+    # the bad step is quarantined as evidence, not deleted...
+    assert os.path.isdir(str(tmp_path / "step_00000003.corrupt"))
+    # ...and no reader ever counts it again
+    assert ck.latest_step() == 2
+    assert ck.all_steps() == [1, 2]
+
+
+def test_restore_cascades_past_two_corrupt_steps(tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=5)
+    s1 = _state(1.0)
+    ck.save(1, s1)
+    ck.save(2, _state(3.0))
+    ck.save(3, _state(7.0))
+    flip_one_byte(_payload(ck, 3))
+    flip_one_byte(_payload(ck, 2))
+    step, restored = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], s1["w"])
+
+
+def test_restore_with_no_intact_fallback_raises_typed(
+        tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    flip_one_byte(_payload(ck, 1))
+    with pytest.raises(CheckpointCorrupt):
+        ck.restore()
+
+
+def test_multihost_restore_refuses_per_rank_fallback(
+        tmp_path, flip_one_byte):
+    """Two-phase mode: a rank whose payload rotted gets the TYPED
+    verdict, never a silent per-rank fallback — this rank restoring
+    step 2 while its peer (whose payload hashes clean) restores step 4
+    would diverge the cluster.  Nothing is quarantined either (the
+    peer's restore of the same promoted step is legitimate); the
+    supervisor restarts the pod from the read-only
+    ``latest_verified_step`` probe instead."""
+    def _mh(rank):
+        ck = Checkpointer(str(tmp_path), rank=rank, world=2)
+        ck._retry.sleep = lambda s: None
+        return ck
+
+    def _st(rank, step):
+        return {"w": np.arange(16.0) + 10 * rank + step}
+
+    for step in (2, 4):
+        _mh(1).save(step, _st(1, step))
+        _mh(0).save(step, _st(0, step))  # leader promotes
+    flip_one_byte(str(tmp_path / "step_00000004" / "host_1"))
+
+    with pytest.raises(CheckpointCorrupt) as ei:
+        _mh(1).restore(template=_st(1, 4))
+    assert "does not fall back per-rank" in "; ".join(ei.value.problems)
+    # the step stays promoted and unquarantined: rank 0's replica is
+    # clean, and its restore of the SAME step must keep succeeding
+    assert os.path.isdir(str(tmp_path / "step_00000004"))
+    assert not os.path.isdir(str(tmp_path / "step_00000004.corrupt"))
+    step, got = _mh(0).restore(template=_st(0, 4))
+    assert step == 4
+    np.testing.assert_array_equal(got["w"], _st(0, 4)["w"])
+    # the pod-restart probe names the common earlier verified step
+    assert _mh(1).latest_verified_step() == 2
+
+
+def test_two_phase_optout_multihost_restore_also_refuses_fallback(
+        tmp_path, monkeypatch, flip_one_byte):
+    """DK_CKPT_TWO_PHASE=0 (per-host LOCAL checkpoint dirs): one
+    host's local copy rotting must get the same typed verdict as the
+    two-phase pod — this rank quietly resuming from step 2 while the
+    peers (whose local copies hash clean) resume from step 4 would
+    diverge the cluster just the same."""
+    monkeypatch.setenv("DK_CKPT_TWO_PHASE", "0")
+    ck = Checkpointer(str(tmp_path), rank=1, world=2, max_to_keep=5)
+    ck.save(2, _state(2.0))
+    ck.save(4, _state(4.0))
+    flip_one_byte(_payload(ck, 4))
+    with pytest.raises(CheckpointCorrupt) as ei:
+        ck.restore()
+    assert "does not fall back per-rank" in "; ".join(ei.value.problems)
+    # nothing quarantined; the probe names the common earlier step
+    assert os.path.isdir(_payload(ck, 4))
+    assert not os.path.isdir(_payload(ck, 4) + ".corrupt")
+    assert ck.latest_verified_step() == 2
+
+
+def test_restore_verify_false_loads_rotted_manifest_payload(tmp_path):
+    """verify=False restores whatever pickle can read — the manifest is
+    not consulted (the bit flipped here lands in the manifest itself so
+    the payload stays loadable)."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state())
+    with open(os.path.join(_payload(ck, 1), MANIFEST_NAME), "a") as f:
+        f.write(" ")  # manifest no longer matches its own tree digest?
+    # a whitespace append keeps valid JSON; rot a listed hash instead
+    mpath = os.path.join(_payload(ck, 1), MANIFEST_NAME)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    rel = next(iter(manifest["files"]))
+    manifest["files"][rel]["sha256"] = "0" * 64
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    # verify=False bypasses the manifest entirely: pickle reads fine
+    assert ck.restore(step=1, verify=False)[0] == 1
+    # the default verified restore condemns it (and, with no fallback
+    # left, quarantines + re-raises the typed error)
+    with pytest.raises(CheckpointCorrupt):
+        ck.restore()
+    assert not os.path.isdir(_payload(ck, 1))
+    assert os.path.isdir(str(tmp_path / "step_00000001.corrupt"))
+
+
+def test_latest_verified_step_skips_corrupt_read_only(
+        tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=5)
+    ck.save(1, _state(1.0))
+    ck.save(2, _state(2.0))
+    flip_one_byte(_payload(ck, 2))
+    assert ck.latest_verified_step() == 1
+    # STRICTLY read-only: the corrupt step was skipped, not quarantined
+    assert os.path.isdir(_payload(ck, 2))
+    assert ck.latest_step() == 2
+
+
+def test_latest_verified_step_empty_dir_is_none(tmp_path):
+    assert Checkpointer(str(tmp_path)).latest_verified_step() is None
+
+
+def test_retention_eventually_retires_quarantined_evidence(
+        tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    ck.save(1, _state(1.0))
+    ck.save(2, _state(2.0))
+    flip_one_byte(_payload(ck, 2))
+    with pytest.raises(CheckpointCorrupt):
+        ck.verify(2)
+    assert ck._quarantine(2)
+    quarantined = str(tmp_path / "step_00000002.corrupt")
+    assert os.path.isdir(quarantined)
+    # quarantine survives saves while its step is on the live horizon
+    ck.save(3, _state(3.0))
+    assert os.path.isdir(quarantined)
+    # ...and is retired once retention moves past it
+    ck.save(4, _state(4.0))
+    ck.save(5, _state(5.0))
+    assert not os.path.isdir(quarantined)
+
+
+# ---------------------------------------------------------------------------
+# retry: the shared deadline surface
+# ---------------------------------------------------------------------------
+def test_remaining_deadline_none_without_timeout():
+    assert RetryPolicy(attempts=2).remaining_deadline() is None
+
+
+def test_remaining_deadline_full_before_any_call():
+    pol = RetryPolicy(attempts=2, timeout=30.0)
+    # a nested surface asking EARLY must read the full budget, not 0
+    assert pol.remaining_deadline() == 30.0
+
+
+def test_remaining_deadline_counts_down_and_clips_at_zero():
+    t = [100.0]
+    pol = RetryPolicy(attempts=2, timeout=10.0, clock=lambda: t[0],
+                      sleep=lambda s: None)
+    pol.start_deadline()
+    t[0] = 104.0
+    assert pol.remaining_deadline() == pytest.approx(6.0)
+    t[0] = 120.0
+    assert pol.remaining_deadline() == 0.0
+
+
+def test_call_arms_the_same_deadline():
+    t = [0.0]
+    pol = RetryPolicy(attempts=1, timeout=5.0, clock=lambda: t[0],
+                      sleep=lambda s: None)
+    pol.call(lambda: t.__setitem__(0, 2.0))
+    assert pol.remaining_deadline() == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# supervisor: restart budget + auto-resume loop
+# ---------------------------------------------------------------------------
+def test_restart_budget_rolling_window():
+    t = [0.0]
+    b = RestartBudget(2, window_s=10.0, clock=lambda: t[0])
+    assert b.record("OSError") is True        # 1 in window
+    assert b.record("OSError") is True        # 2 in window
+    assert b.record("OSError") is False       # 3 > budget
+    t[0] = 20.0                               # window slides past all
+    assert b.record("OSError") is True
+    assert len(b.evidence) == 1
+
+
+def test_restart_budget_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="max_restarts"):
+        RestartBudget(-1, 10.0)
+    with pytest.raises(ValueError, match="window"):
+        RestartBudget(1, 0.0)
+
+
+def test_supervise_restarts_transient_then_returns():
+    calls = []
+    sleeps = []
+
+    def fn(attempt, resume_step):
+        calls.append((attempt, resume_step))
+        if attempt < 2:
+            raise OSError(f"transient {attempt}")
+        return "done"
+
+    assert supervise(fn, max_restarts=3, backoff=0.1, multiplier=2.0,
+                     budget_window_s=60.0,
+                     sleep=sleeps.append) == "done"
+    assert calls == [(0, None), (1, None), (2, None)]
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_supervise_fatal_never_retried():
+    calls = []
+
+    def fn(attempt, resume_step):
+        calls.append(attempt)
+        raise ValueError("bad config")
+
+    with pytest.raises(ValueError, match="bad config"):
+        supervise(fn, max_restarts=3, backoff=0.0, budget_window_s=60.0)
+    assert calls == [0]
+
+
+def test_supervise_poisoned_coordinator_is_fatal():
+    from dist_keras_tpu.resilience.coordination import CoordinatorPoisoned
+
+    calls = []
+
+    def fn(attempt, resume_step):
+        calls.append(attempt)
+        raise CoordinatorPoisoned("op stream desynced")
+
+    with pytest.raises(CoordinatorPoisoned):
+        supervise(fn, max_restarts=3, backoff=0.0, budget_window_s=60.0)
+    assert calls == [0]  # tested BEFORE the generic RuntimeError path
+
+
+def test_supervise_crash_loop_gives_up_typed_with_evidence():
+    def fn(attempt, resume_step):
+        raise OSError(f"boom {attempt}")
+
+    with pytest.raises(CrashLoop) as ei:
+        supervise(fn, max_restarts=2, backoff=0.0, budget_window_s=60.0)
+    # budget of 2 restarts = 3 attempts; every failure is in evidence
+    assert len(ei.value.evidence) == 3
+    assert ei.value.reason == "crash_loop"
+    assert "boom" in str(ei.value)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_supervise_deadline_gives_up_typed():
+    t = [0.0]
+
+    def fn(attempt, resume_step):
+        t[0] += 10.0  # each attempt burns 10 "seconds"
+        raise OSError("slow boom")
+
+    with pytest.raises(CrashLoop) as ei:
+        supervise(fn, max_restarts=100, backoff=0.0,
+                  budget_window_s=1e9, deadline_s=25.0,
+                  clock=lambda: t[0], sleep=lambda s: None)
+    assert ei.value.reason == "deadline"
+    assert t[0] == pytest.approx(30.0)  # gave up at the first overrun
+
+
+def test_supervise_preempted_clears_flag_and_passes_verified_step(
+        tmp_path, flip_one_byte):
+    ck = Checkpointer(str(tmp_path), max_to_keep=5)
+    ck.save(1, _state(1.0))
+    ck.save(2, _state(2.0))
+    flip_one_byte(_payload(ck, 2))  # the latest step rotted on disk
+    calls = []
+
+    def fn(attempt, resume_step):
+        calls.append((attempt, resume_step))
+        if attempt == 0:
+            preemption.request()  # the SIGTERM path sets the flag...
+            raise Preempted(15, saved_step=2)
+        assert not preemption.requested()  # ...cleared before relaunch
+        return "resumed"
+
+    assert supervise(fn, ck, max_restarts=2, backoff=0.0,
+                     budget_window_s=60.0) == "resumed"
+    # the relaunch resumes from the latest VERIFIED step (1), not the
+    # corrupt latest (2) — the supervisor never hands out rotted bytes
+    assert calls == [(0, 1), (1, 1)]
+
+
+def test_supervise_probe_failure_is_budgeted_not_fatal(tmp_path):
+    """A transient OSError out of the latest_verified_step PROBE (a
+    flaky checkpoint dir's listdir) is budgeted and retried exactly
+    like the same error out of fn — not an untyped supervisor crash."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _state(1.0))
+    real = ck.latest_verified_step
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient listdir failure")
+        return real()
+
+    ck.latest_verified_step = flaky
+    runs = []
+
+    def fn(attempt, resume_step):
+        runs.append((attempt, resume_step))
+        return "done"
+
+    assert supervise(fn, ck, max_restarts=2, backoff=0.0,
+                     budget_window_s=60.0) == "done"
+    # attempt 0 died in the probe itself; attempt 1 ran fn with the step
+    assert runs == [(1, 1)]
+
+
+def test_supervise_on_restart_hook_sees_error_and_delay():
+    seen = []
+
+    def fn(attempt, resume_step):
+        if attempt == 0:
+            raise OSError("once")
+        return attempt
+
+    supervise(fn, max_restarts=1, backoff=0.25, budget_window_s=60.0,
+              sleep=lambda s: None,
+              on_restart=lambda a, e, d: seen.append((a, type(e), d)))
+    assert seen == [(1, OSError, pytest.approx(0.25))]
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule: seeded fault arming
+# ---------------------------------------------------------------------------
+def test_chaos_schedule_is_pure_function_of_seed():
+    a = faults.chaos_schedule(7, rate=0.5, horizon=10)
+    b = faults.chaos_schedule(7, rate=0.5, horizon=10)
+    assert [(s.point, s.at, s.exc) for s in a] \
+        == [(s.point, s.at, s.exc) for s in b]
+    # draws are consumed whether or not a point arms: tightening the
+    # rate never reshuffles a still-armed point's fire index
+    tight = {s.point: s.at for s in faults.chaos_schedule(
+        7, rate=0.25, horizon=10)}
+    loose = {s.point: s.at for s in a}
+    for point, at in tight.items():
+        assert loose[point] == at
+
+
+def test_chaos_schedule_rate_bounds():
+    assert faults.chaos_schedule(3, rate=0.0) == []
+    full = faults.chaos_schedule(3, rate=1.0, horizon=5)
+    assert {s.point for s in full} == set(faults.KNOWN_POINTS)
+    assert all(0 <= s.at < 5 for s in full)
+    assert all(s.exc in (OSError, FaultInjected) for s in full)
+    with pytest.raises(ValueError, match="rate"):
+        faults.chaos_schedule(3, rate=1.5)
+    with pytest.raises(ValueError, match="horizon"):
+        faults.chaos_schedule(3, horizon=0)
+
+
+def test_chaos_env_arms_known_points(monkeypatch):
+    monkeypatch.setenv("DK_FAULTS_SEED", "42")
+    monkeypatch.setenv("DK_FAULTS_RATE", "1.0")
+    monkeypatch.setenv("DK_FAULTS_HORIZON", "1")
+    monkeypatch.setenv("DK_FAULTS_POINTS", "stream.fetch")
+    faults.load_env(force=True)
+    with pytest.raises((OSError, FaultInjected)):  # seeded coin flip
+        faults.fault_point("stream.fetch")
+    faults.fault_point("checkpoint.save")  # restricted set: unarmed
+
+
+def test_chaos_env_malformed_fails_loudly(monkeypatch):
+    monkeypatch.setenv("DK_FAULTS_SEED", "not-an-int")
+    with pytest.raises(ValueError, match="DK_FAULTS_SEED"):
+        faults.load_env(force=True)
+    monkeypatch.setenv("DK_FAULTS_SEED", "1")
+    monkeypatch.setenv("DK_FAULTS_RATE", "often")
+    with pytest.raises(ValueError, match="DK_FAULTS_RATE"):
+        faults.load_env(force=True)
+    monkeypatch.setenv("DK_FAULTS_RATE", "0.5")
+    monkeypatch.setenv("DK_FAULTS_POINTS", "no.such.point")
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.load_env(force=True)
+
+
+# ---------------------------------------------------------------------------
+# launcher-side supervision: Job(supervise=...)
+# ---------------------------------------------------------------------------
+def _job(tmp_path, **kw):
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jobdir"
+    jd.mkdir(exist_ok=True)
+    return Job("s", "j1", str(jd), hosts=["h0", "h1"], dry_run=True,
+               coord_dir=str(tmp_path / "coord"), **kw)
+
+
+def test_job_supervise_knob_forms(tmp_path):
+    assert _job(tmp_path).supervise is None
+    assert _job(tmp_path, supervise=True).supervise["max_restarts"] == 3
+    assert _job(tmp_path, supervise=5).supervise["max_restarts"] == 5
+    j = _job(tmp_path, supervise={"max_restarts": 1, "interval_s": 0.5})
+    assert j.supervise["max_restarts"] == 1
+    assert j.supervise["interval_s"] == 0.5
+    with pytest.raises(ValueError, match="unknown supervise knob"):
+        _job(tmp_path, supervise={"retries": 3})
+
+
+def test_job_supervise_run_requires_arming_and_coord_dir(tmp_path):
+    from dist_keras_tpu.launch.job import Job
+
+    with pytest.raises(ValueError, match="supervise"):
+        _job(tmp_path).supervise_run(max_polls=1)
+    jd = tmp_path / "jd2"
+    jd.mkdir()
+    plain = Job("s", "j2", str(jd), hosts=["h0"], dry_run=True,
+                supervise=1)
+    with pytest.raises(ValueError, match="coord_dir"):
+        plain.supervise_run(max_polls=1)
+
+
+def test_job_supervise_run_relaunches_whole_pod(tmp_path):
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    # host 0 beats; host 1 never does -> heartbeat-proven dead
+    Heartbeat(str(tmp_path / "coord"), rank=0).beat_once()
+    relaunched = job.supervise_run(max_polls=1, out=None,
+                                   stale_after_s=60)
+    # one WAVE naming the dead rank; membership is per-incarnation so
+    # BOTH hosts are re-synced and relaunched under the rotated session
+    assert relaunched == [((1,), 1)]
+    cmds = [" ".join(c) for c in job.commands]
+    for host in ("h0", "h1"):
+        # the old incarnation is retired FIRST (best-effort TERM via
+        # job.pid — a survivor must not keep writing checkpoints), and
+        # the relaunch logs to a per-incarnation file so the dead
+        # run's post-mortem survives
+        assert any(f"ssh {host}" in c and "kill -s TERM" in c
+                   and "job.pid" in c for c in cmds)
+        assert any("rsync" in c and f"{host}:" in c for c in cmds)
+        assert any(f"ssh {host}" in c and "DK_COORD_SESSION=1" in c
+                   and "job.log.1" in c for c in cmds)
+    first_kill = next(i for i, c in enumerate(cmds)
+                      if "kill -s TERM" in c)
+    first_sync = next(i for i, c in enumerate(cmds) if "rsync" in c)
+    assert first_kill < first_sync
+    # the relaunch runs the entrypoint under setsid in its own process
+    # group with the leader pid recorded in job.pid — the handle the
+    # group kill above needs to actually reach the python child
+    assert any("setsid" in c and "job.pid" in c for c in cmds)
+
+
+def test_job_launch_host_rc_dir_stays_shell_safe(tmp_path):
+    """The rc-write path interpolates coord_dir into the remote shell:
+    the constructor's charset gate rejects spaces/metacharacters
+    outright (nothing unquotable ever reaches ``launch_host``), the
+    quoted form is a byte-identical no-op for every admitted path, and
+    a leading ``~`` renders as ``"$HOME"`` so it still expands on the
+    remote (workers expanduser() the very same string in python)."""
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jobdir"
+    jd.mkdir(exist_ok=True)
+    with pytest.raises(ValueError, match="coord_dir"):
+        Job("s", "jq", str(jd), hosts=["h0"], dry_run=True,
+            coord_dir=str(tmp_path / "my runs" / "coord"))
+    coord = str(tmp_path / "coord")
+    job = Job("s", "jq", str(jd), hosts=["h0"], dry_run=True,
+              coord_dir=coord)
+    job.launch_host(0)
+    cmd = " ".join(job.commands[-1])
+    assert f"mkdir -p {coord}/rc &&" in cmd
+    tilde = Job("s", "jt", str(jd), hosts=["h0"], dry_run=True,
+                coord_dir="~/dkcoord")
+    tilde.launch_host(0, session=3)
+    cmd = " ".join(tilde.commands[-1])
+    assert 'mkdir -p "$HOME"/dkcoord/3/rc &&' in cmd
+    assert 'rc/rank_0' in cmd
+
+
+def test_job_supervise_run_judges_new_session_after_wave(tmp_path):
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    Heartbeat(str(tmp_path / "coord"), rank=0).beat_once()
+    # after wave 1 the new incarnation comes up healthy IN SESSION 1:
+    # the supervisor must probe coord_dir/1, see both ranks beating,
+    # and stop relaunching (the old session-0 heartbeats stay stale)
+    Heartbeat(str(tmp_path / "coord" / "1"), rank=0).beat_once()
+    Heartbeat(str(tmp_path / "coord" / "1"), rank=1).beat_once()
+    relaunched = job.supervise_run(max_polls=3, out=None,
+                                   stale_after_s=60)
+    assert relaunched == [((1,), 1)]
+
+
+def test_job_supervise_run_budget_counts_waves_not_hosts(tmp_path):
+    # every incarnation's heartbeats go stale (beat once, went dark —
+    # dead_peers is strictly evidence-based, so a pod that NEVER beat
+    # would be no verdict, not all-dead): each poll sees the whole pod
+    # dead.  With a budget of 1 the first wave is in budget (ONE
+    # recording for both dead hosts, not two) and the second wave,
+    # judged in the rotated session's own heartbeat dir, dies typed.
+    import time
+
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 1, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    old = time.time() - 3600
+    for root in (tmp_path / "coord", tmp_path / "coord" / "1"):
+        for rank in (0, 1):
+            Heartbeat(str(root), rank=rank).beat_once()
+            os.utime(os.path.join(str(root), "hb", f"rank_{rank}"),
+                     (old, old))
+    with pytest.raises(CrashLoop) as ei:
+        job.supervise_run(max_polls=4, out=None, stale_after_s=60)
+    assert "rank 0" in str(ei.value) and "rank 1" in str(ei.value)
+    assert len(ei.value.evidence) == 2  # two waves, not four hosts
+
+
+def test_job_supervise_run_failed_wave_is_not_silence(tmp_path):
+    """A relaunch wave that never produces a single heartbeat (all-host
+    transport failure or instant crash) must read as a dead pod on the
+    next post-grace poll — dead_peers' absence-of-evidence rule (no hb
+    dir -> no verdict) would otherwise stall supervision forever with
+    the pod down and nothing reported."""
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 1, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    Heartbeat(str(tmp_path / "coord"), rank=0).beat_once()  # rank 1 dead
+    # dry_run launches nothing, so session 1 never heartbeats: wave 1
+    # is in budget, then the silent new session is judged ALL-dead and
+    # wave 2 overflows the budget -> typed giveup, not an idle loop
+    with pytest.raises(CrashLoop) as ei:
+        job.supervise_run(max_polls=3, out=None, stale_after_s=60)
+    assert "rank 0" in str(ei.value) and "rank 1" in str(ei.value)
+    assert len(ei.value.evidence) == 2
+
+
+def test_job_supervise_run_completed_pod_is_not_relaunched(tmp_path):
+    """A finished run leaves STALE heartbeats by design — without the
+    launch wrappers' positive completion evidence the supervisor would
+    relaunch a pod that exited rc=0 forever.  All-zero rcs end
+    supervision instead."""
+    import time as _time
+
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    old = _time.time() - 3600
+    for rank in (0, 1):
+        Heartbeat(str(tmp_path / "coord"), rank=rank).beat_once()
+        os.utime(os.path.join(str(tmp_path / "coord"), "hb",
+                              f"rank_{rank}"), (old, old))
+    rc_dir = tmp_path / "coord" / "rc"
+    rc_dir.mkdir()
+    for rank in (0, 1):
+        (rc_dir / f"rank_{rank}").write_text("0\n")
+    relaunched = job.supervise_run(max_polls=5, out=None,
+                                   stale_after_s=60)
+    assert relaunched == []
+    assert not any("rsync" in " ".join(c) for c in job.commands)
+
+
+def test_job_supervise_run_rc_zero_exempts_only_that_rank(tmp_path):
+    # rank 0 completed (stale heartbeat + rc 0); rank 1 went dark
+    # mid-run (stale heartbeat, no rc): the pod is still relaunched,
+    # and the wave names rank 1 alone
+    import time as _time
+
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    old = _time.time() - 3600
+    for rank in (0, 1):
+        Heartbeat(str(tmp_path / "coord"), rank=rank).beat_once()
+        os.utime(os.path.join(str(tmp_path / "coord"), "hb",
+                              f"rank_{rank}"), (old, old))
+    rc_dir = tmp_path / "coord" / "rc"
+    rc_dir.mkdir()
+    (rc_dir / "rank_0").write_text("0\n")
+    relaunched = job.supervise_run(max_polls=1, out=None,
+                                   stale_after_s=60)
+    assert relaunched == [((1,), 1)]
+
+
+def test_job_supervise_run_nonzero_rc_is_crash_evidence(tmp_path):
+    # the pod crashed before its FIRST beat: no hb dir at all, so the
+    # heartbeat plane gives no verdict (absence of evidence) — but the
+    # wrappers recorded nonzero rcs, which convict on their own
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    rc_dir = tmp_path / "coord" / "rc"
+    rc_dir.mkdir(parents=True)
+    for rank in (0, 1):
+        (rc_dir / f"rank_{rank}").write_text("143\n")
+    relaunched = job.supervise_run(max_polls=1, out=None,
+                                   stale_after_s=60)
+    assert relaunched == [((0, 1), 1)]
+
+
+def test_job_host_rcs_reads_and_skips_garbled(tmp_path):
+    job = _job(tmp_path)
+    rc_dir = tmp_path / "coord" / "rc"
+    rc_dir.mkdir(parents=True)
+    (rc_dir / "rank_0").write_text("0\n")
+    (rc_dir / "rank_1").write_text("garbled")  # torn mid-write
+    (rc_dir / "notarank").write_text("7")
+    assert job.host_rcs() == {0: 0}
+    # rotated incarnations record under their own session subdir
+    s_dir = tmp_path / "coord" / "2" / "rc"
+    s_dir.mkdir(parents=True)
+    (s_dir / "rank_1").write_text("143")
+    assert job.host_rcs(session=2) == {1: 143}
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jd-norc"
+    jd.mkdir()
+    with pytest.raises(ValueError, match="coord_dir"):
+        Job("s", "j", str(jd), hosts=["h0"], dry_run=True).host_rcs()
+
+
+def test_job_supervise_run_budget_exhaustion_is_typed(tmp_path):
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 0, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    Heartbeat(str(tmp_path / "coord"), rank=0).beat_once()
+    with pytest.raises(CrashLoop) as ei:
+        job.supervise_run(max_polls=2, out=None, stale_after_s=60)
+    assert "rank 1" in str(ei.value)
+    assert ei.value.evidence
+
+
+def test_job_config_accepts_supervise(tmp_path):
+    from dist_keras_tpu.launch.config import JobConfig
+
+    jd = tmp_path / "jd"
+    jd.mkdir()
+    base = {"secret": "s", "job_name": "j", "job_dir": str(jd),
+            "hosts": ["h0"]}
+    assert JobConfig.from_dict({**base, "supervise": 2}).supervise == 2
+    assert JobConfig.from_dict(
+        {**base, "supervise": True}).supervise is True
+    assert JobConfig.from_dict(
+        {**base, "supervise": {"max_restarts": 1}}
+    ).supervise == {"max_restarts": 1}
+    with pytest.raises(ValueError):
+        JobConfig.from_dict({**base, "supervise": "yes"})

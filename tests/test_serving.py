@@ -289,6 +289,138 @@ def test_checkpoint_watcher_background_loop_and_fault(tmp_path):
         eng.close()
 
 
+def test_checkpoint_watcher_skips_corrupt_step(tmp_path, flip_one_byte):
+    """A rotted promoted step is SKIPPED (typed event, old params kept)
+    — previously it would fail inside the restore mid-swap attempt."""
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        base = eng.predict(_rows(4), timeout_s=120)
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
+        w = CheckpointWatcher(eng, ck, poll_s=0.5)
+        ck.save(1, {"params": jax.tree.map(
+            lambda a: np.asarray(a) * 0.25, m.params)})
+        flip_one_byte(str(tmp_path / "ck" / "step_00000001"))
+        assert w.poll_once() is None  # skipped, not raised
+        assert w.skipped_corrupt == 1 and w.reloads == 0
+        # old params kept serving; the bad step is marked seen so the
+        # watcher does not hot-loop verification against dead bytes
+        np.testing.assert_allclose(
+            eng.predict(_rows(4), timeout_s=120), base)
+        assert w.last_step == 1
+        # the trainer's next good promotion supersedes it
+        ck.save(2, {"params": jax.tree.map(
+            lambda a: np.asarray(a) * 0.25, m.params)})
+        assert w.poll_once() == 2
+        assert not np.allclose(
+            eng.predict(_rows(4), timeout_s=120), base)
+    finally:
+        eng.close()
+
+
+def test_checkpoint_watcher_falls_back_to_newest_verified_step(
+        tmp_path, flip_one_byte):
+    """Trainer promotes 1 (intact) then 2 (rots on disk): the watcher
+    loads 1 rather than serving stale params until step 3 lands, and
+    marks the corrupt 2 as seen (no verification hot-loop)."""
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        base = eng.predict(_rows(4), timeout_s=120)
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=5)
+        w = CheckpointWatcher(eng, ck, poll_s=0.5, initial_step=0)
+
+        def scale(k):
+            return {"params": jax.tree.map(
+                lambda a: np.asarray(a) * k, m.params)}
+
+        ck.save(1, scale(0.25))
+        ck.save(2, scale(0.5))
+        flip_one_byte(str(tmp_path / "ck" / "step_00000002"))
+        assert w.poll_once() == 1      # newest VERIFIABLE, not None
+        assert w.reloads == 1 and w.skipped_corrupt == 1
+        assert w.last_step == 2        # the corrupt step is seen too
+        assert not np.allclose(
+            eng.predict(_rows(4), timeout_s=120), base)
+        assert w.poll_once() is None   # dead bytes are not re-verified
+        assert w.skipped_corrupt == 1
+        ck.save(3, scale(0.75))        # the next promotion supersedes
+        assert w.poll_once() == 3
+    finally:
+        eng.close()
+
+
+def test_checkpoint_watcher_restore_failure_keeps_convictions(
+        tmp_path, monkeypatch, flip_one_byte):
+    """A restore failure on the chosen INTACT step keeps last_step put
+    (the restore is retried next poll) but must NOT forget which newer
+    steps were already convicted corrupt — re-hashing their whole
+    payloads and re-emitting reload_skipped_corrupt every poll until
+    the reload succeeds would over-report one rotted step N times."""
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=5)
+        w = CheckpointWatcher(eng, ck, poll_s=0.5, initial_step=0)
+        ck.save(1, {"params": m.params})
+        ck.save(2, {"params": m.params})
+        flip_one_byte(str(tmp_path / "ck" / "step_00000002"))
+        real_restore = ck.restore
+        monkeypatch.setattr(
+            ck, "restore",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("hiccup")))
+        with pytest.raises(OSError):
+            w.poll_once()  # 2 convicted, 1 chosen, restore fails
+        assert w.skipped_corrupt == 1
+        assert w.last_step == 0  # the intact step is retried next poll
+        monkeypatch.setattr(ck, "restore", real_restore)
+        assert w.poll_once() == 1
+        assert w.skipped_corrupt == 1  # dead bytes were not re-hashed
+        assert w.last_step == 2
+    finally:
+        eng.close()
+
+
+def test_checkpoint_watcher_never_quarantines_rot_after_probe(
+        tmp_path, monkeypatch):
+    """A step that rots BETWEEN the read-only probe and the restore
+    must not trip the verified-restore path: a reader process
+    quarantining (renaming) the trainer's live directory — or silently
+    serving fallen-back step-N-1 params stamped as step N — would be
+    worse than a typed reload error.  The watcher's restore therefore
+    runs ``verify=False`` (the probe already passed)."""
+    from dist_keras_tpu.checkpoint import MANIFEST_NAME
+
+    m = _model()
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
+        w = CheckpointWatcher(eng, ck, poll_s=0.5, initial_step=0)
+        ck.save(1, {"params": m.params})
+        # simulate rot-after-probe: the probe saw the step intact...
+        monkeypatch.setattr(ck, "verify", lambda step=None: "ok")
+        # ...then a listed hash rotted (payload bytes still loadable)
+        mpath = str(tmp_path / "ck" / "step_00000001" / MANIFEST_NAME)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        rel = next(iter(manifest["files"]))
+        manifest["files"][rel]["sha256"] = "0" * 64
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        assert w.poll_once() == 1  # loads: no re-verify inside restore
+        assert w.reloads == 1
+        # the reader NEVER renamed anything in the trainer's directory
+        assert os.path.isdir(str(tmp_path / "ck" / "step_00000001"))
+        assert not os.path.isdir(
+            str(tmp_path / "ck" / "step_00000001.corrupt"))
+    finally:
+        eng.close()
+
+
 def test_checkpointer_wait_for_step_after(tmp_path):
     ck = Checkpointer(str(tmp_path), max_to_keep=2)
     assert ck.wait_for_step_after(timeout_s=0.05, poll_s=0.01) is None
