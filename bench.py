@@ -42,9 +42,14 @@ in run order:
    without ``DK_CKPT_VERIFY`` (integrity manifests) + raw SHA-256
    throughput, CPU-pinned subprocess; also run in the
    backend-unresponsive early-exit path, like serving.
-10. Transformer — composite dp x tp x sp step (ring + flash attention);
+10. Retrace proxy — CPU-measurable attribution rows (jit retrace +
+   dispatch counts, H2D/D2H proxy bytes, data/step/comm/ckpt host
+   walls) for a streamed windowed trainer, CPU-pinned subprocess; the
+   warm-run retrace delta is the "no steady-state retraces" claim.
+   Also runs in the backend-unresponsive early-exit path.
+11. Transformer — composite dp x tp x sp step (ring + flash attention);
    new capability, no reference counterpart (vs_baseline: null).
-11. Long-context — T=32k causal step, flash kernels + remat="mlp";
+12. Long-context — T=32k causal step, flash kernels + remat="mlp";
    reports hardware MFU (attention-aware) AND param-only MFU.
 
 Baseline denominators (measured in this image with Keras 3 + TF CPU
@@ -611,28 +616,51 @@ def bench_adag_streamed(peak):
     }
 
 
-def bench_serving(peak=None, timeout_s=300):
-    """Online-serving benchmark: sustained QPS + p50/p99 latency at
-    fixed offered load (``dist_keras_tpu.serving.bench``), run in a
-    CPU-PINNED SUBPROCESS.  Two reasons: (a) serving is a host-side
-    concurrency measurement, not an MXU one — CPU numbers are the
-    honest, reproducible floor; (b) the subprocess never touches the
-    device backend, so this config still measures when the tunnel is
-    wedged and the probe times out — BENCH rounds stop being all-null
-    (the r05 failure mode: rc=124, parsed=null, nothing measured)."""
+def _run_cpu_worker(name, argv=None, source=None, args=(),
+                    strip_prefixes=(), timeout_s=300):
+    """Run one CPU-pinned bench worker in a subprocess and parse the
+    last JSON line of its stdout into a named record — the shared
+    mechanics of every host-side row that must still measure when the
+    device tunnel is wedged (``bench_serving``, ``bench_retrace_proxy``,
+    ``bench_ckpt_manifest``).  ``argv`` runs as-is (module workers);
+    ``source`` is written to a temp script first (inline workers, with
+    ``args`` appended).  The telemetry/fault/alert knobs of the OUTER
+    process are ALWAYS stripped — an inherited ``DK_OBS_SAMPLE_S``
+    would run the sampler inside a measured latency, an inherited
+    ``DK_METRICS_PORT`` would fight the live exporter for its socket,
+    and an injected fault or alert webhook must never cross into a
+    measurement; ``strip_prefixes`` adds each row's own extras.
+    Timeouts and non-zero exits return typed error records, never
+    raise."""
     import subprocess
+    import tempfile
 
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    strip = ("DK_OBS", "DK_FAULTS", "DK_METRICS", "DK_WATCHDOG",
+             "DK_ALERT") + tuple(strip_prefixes)
+    env = {k: v for k, v in os.environ.items()
+           if k != "XLA_FLAGS" and not k.startswith(strip)}
     env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (repo + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    script = None
+    if source is not None:
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".py", delete=False) as f:
+            f.write(source)
+            script = f.name
+        argv = [script, *[str(a) for a in args]]
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "dist_keras_tpu.serving.bench",
-             "--qps", "400", "--seconds", "4"],
+            [sys.executable, *argv],
             capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            cwd=repo)
     except subprocess.TimeoutExpired:
-        return {"name": "serving_cpu_offered_load",
-                "error": f"serving bench timed out after {timeout_s}s"}
+        return {"name": name,
+                "error": f"{name} timed out after {timeout_s}s"}
+    finally:
+        if script is not None:
+            os.unlink(script)
     rec = None
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -641,14 +669,113 @@ def bench_serving(peak=None, timeout_s=300):
         except ValueError:
             continue
     if proc.returncode != 0 or rec is None:
-        return {"name": "serving_cpu_offered_load",
+        return {"name": name,
                 "error": f"rc={proc.returncode}: "
                          + (proc.stderr or proc.stdout)[-200:]}
-    rec["name"] = "serving_cpu_offered_load"
+    rec["name"] = name
     rec["platform"] = "cpu"
-    rec["vs_baseline"] = None  # no reference counterpart (SURVEY §2.4
-    #                            is pull-based streaming, not serving)
+    rec["vs_baseline"] = None  # host-side rows have no reference rate
     return rec
+
+
+def bench_serving(peak=None, timeout_s=300):
+    """Online-serving benchmark: sustained QPS + p50/p99 latency at
+    fixed offered load (``dist_keras_tpu.serving.bench``), run in a
+    CPU-PINNED SUBPROCESS.  Two reasons: (a) serving is a host-side
+    concurrency measurement, not an MXU one — CPU numbers are the
+    honest, reproducible floor; (b) the subprocess never touches the
+    device backend, so this config still measures when the tunnel is
+    wedged and the probe times out — BENCH rounds stop being all-null
+    (the r05 failure mode: rc=124, parsed=null, nothing measured).
+    No reference counterpart for ``vs_baseline`` (SURVEY §2.4 is
+    pull-based streaming, not serving)."""
+    return _run_cpu_worker(
+        "serving_cpu_offered_load",
+        argv=["-m", "dist_keras_tpu.serving.bench",
+              "--qps", "400", "--seconds", "4"],
+        timeout_s=timeout_s)
+
+
+# The retrace-proxy worker: CPU-measurable attribution rows for the
+# device-only perf claims while the device probe is down (ROADMAP item
+# 5): jit retrace count (via the jax.monitoring listener), framework
+# dispatch count, H2D/D2H proxy bytes and the per-phase host walls for
+# a windowed trainer (ADAG, streamed so the ChunkFeed H2D path runs).
+# Two back-to-back runs: the cold one owns the compiles; the warm one
+# is the steady-state claim — its retrace delta SHOULD be 0 (recorded,
+# not asserted: the bench records, gates assert).
+_RETRACE_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.data import Dataset
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.observability import metrics, perf
+from dist_keras_tpu.trainers import ADAG
+from dist_keras_tpu.utils.misc import one_hot
+
+perf.install()
+rng = np.random.default_rng(0)
+n = 256 * 16
+y = rng.integers(0, 2, n)
+ds = Dataset({"features": rng.normal(size=(n, 32)).astype(np.float32),
+              "label": y, "label_encoded": one_hot(y, 2)})
+
+
+def make():
+    return ADAG(mnist_mlp(hidden=(64,), input_dim=32, num_classes=2),
+                num_workers=1, communication_window=4, batch_size=256,
+                num_epoch=8, label_col="label_encoded",
+                stream_chunk_windows=2)
+
+
+KEYS = ("perf.retraces", "perf.dispatches", "perf.h2d_bytes",
+        "perf.d2h_bytes")
+
+
+def counters():
+    c = metrics.snapshot()["counters"]
+    return {k: c.get(k, 0) for k in KEYS}
+
+
+def phase_walls():
+    h = metrics.snapshot()["histograms"]
+    return {k[len("perf.phase."):]: {"count": v["count"],
+                                     "total_s": round(v["total"], 4)}
+            for k, v in h.items() if k.startswith("perf.phase.")}
+
+
+c0 = counters()
+make().train(ds)                       # cold: owns the compiles
+c1 = counters()
+t = make()
+t.train(ds)                            # warm: the steady-state claim
+c2 = counters()
+print(json.dumps({
+    "retraces_cold": c1["perf.retraces"] - c0["perf.retraces"],
+    "retraces_warm": c2["perf.retraces"] - c1["perf.retraces"],
+    "dispatches_warm": c2["perf.dispatches"] - c1["perf.dispatches"],
+    "h2d_bytes_warm": c2["perf.h2d_bytes"] - c1["perf.h2d_bytes"],
+    "d2h_bytes_warm": c2["perf.d2h_bytes"] - c1["perf.d2h_bytes"],
+    "train_s_warm": round(t.get_training_time(), 4),
+    "phase_walls": phase_walls(),
+}))
+"""
+
+
+def bench_retrace_proxy(peak=None, timeout_s=300):
+    """CPU-proxy attribution row (``bench_retrace_proxy``): retrace +
+    dispatch counts, transfer-byte proxies and the data/step/comm/ckpt
+    host walls for a streamed windowed trainer, in a CPU-pinned
+    subprocess — so every device-only perf claim has an attribution row
+    even while the device probe is down, including in the
+    backend-unresponsive early-exit path.  An attribution row, not a
+    reference rate — ``vs_baseline`` stays null."""
+    return _run_cpu_worker(
+        "bench_retrace_proxy", source=_RETRACE_WORKER,
+        timeout_s=timeout_s)
 
 
 # The manifest-overhead worker: measures Checkpointer.save wall with
@@ -709,46 +836,12 @@ def bench_ckpt_manifest(peak=None, mb=64, reps=5, timeout_s=300):
     """Integrity-manifest cost: ``Checkpointer.save`` with vs without
     ``DK_CKPT_VERIFY`` (median-of-``reps`` on a ``mb``-MB pytree) plus
     the raw SHA-256 throughput — so the price of the self-healing layer
-    is tracked in every BENCH round, not asserted once and forgotten."""
-    import subprocess
-    import tempfile
-
-    env = {k: v for k, v in os.environ.items()
-           if k != "XLA_FLAGS" and not k.startswith("DK_CKPT")}
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.abspath(__file__))
-    env["PYTHONPATH"] = (repo + os.pathsep
-                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
-    with tempfile.NamedTemporaryFile(
-            "w", suffix=".py", delete=False) as f:
-        f.write(_CKPT_MANIFEST_WORKER)
-        script = f.name
-    try:
-        proc = subprocess.run(
-            [sys.executable, script, str(mb), str(reps)],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=repo)
-    except subprocess.TimeoutExpired:
-        return {"name": "ckpt_manifest_overhead",
-                "error": f"manifest bench timed out after {timeout_s}s"}
-    finally:
-        os.unlink(script)
-    rec = None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            rec = json.loads(line)
-            break
-        except ValueError:
-            continue
-    if proc.returncode != 0 or rec is None:
-        return {"name": "ckpt_manifest_overhead",
-                "error": f"rc={proc.returncode}: "
-                         + (proc.stderr or proc.stdout)[-200:]}
-    rec["name"] = "ckpt_manifest_overhead"
-    rec["platform"] = "cpu"
-    rec["vs_baseline"] = None  # no reference counterpart (the
-    #                            reference has no checkpoint integrity)
-    return rec
+    is tracked in every BENCH round, not asserted once and forgotten.
+    No ``vs_baseline`` (the reference has no checkpoint integrity)."""
+    return _run_cpu_worker(
+        "ckpt_manifest_overhead", source=_CKPT_MANIFEST_WORKER,
+        args=(mb, reps), strip_prefixes=("DK_CKPT",),
+        timeout_s=timeout_s)
 
 
 def _backend_responsive(timeout_s=180):
@@ -898,7 +991,9 @@ def main():
         for fn, fallback_name in ((bench_serving,
                                    "serving_cpu_offered_load"),
                                   (bench_ckpt_manifest,
-                                   "ckpt_manifest_overhead")):
+                                   "ckpt_manifest_overhead"),
+                                  (bench_retrace_proxy,
+                                   "bench_retrace_proxy")):
             t0 = time.time()
             _obs_emit("bench_config_begin", name=fn.__name__)
             try:
@@ -927,7 +1022,8 @@ def main():
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
                bench_adag_streamed, bench_serving, bench_ckpt_manifest,
-               bench_transformer_tp, bench_long_context):
+               bench_retrace_proxy, bench_transformer_tp,
+               bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,

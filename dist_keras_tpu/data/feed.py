@@ -35,6 +35,10 @@ can PROVE the residency bound instead of trusting it.
 
 from __future__ import annotations
 
+import time
+
+from dist_keras_tpu.observability import perf
+
 
 class ChunkFeed:
     """Serve device-resident chunks of host arrays, one-chunk-ahead.
@@ -70,7 +74,14 @@ class ChunkFeed:
             return
         start, length = self._spans[i]
         views = tuple(a[:, start:start + length] for a in self._arrays)
+        # perf attribution: bytes shipped + the async ENQUEUE wall (the
+        # DMA itself overlaps compute by design — that overlap is the
+        # point of this feed; the blocking side lands in the retire's
+        # d2h wall)
+        t0 = time.perf_counter()
         self._bufs[i] = self._put(*views)
+        perf.h2d(sum(v.nbytes for v in views),
+                 time.perf_counter() - t0)
         self.put_count += 1
         self.peak_resident_chunks = max(self.peak_resident_chunks,
                                         len(self._bufs))

@@ -63,6 +63,12 @@ class JobConfig:
     # entrypoint that starts serving.ServingServer(port=None) binds it
     # on every host, so one descriptor launches a serving fleet
     serve_port: int | None = None
+    # per-host Prometheus scrape port, exported as DK_METRICS_PORT: the
+    # observability.prometheus exporter binds it on every host (one
+    # scrape config covers the pod); obs_sample_s exports
+    # DK_OBS_SAMPLE_S — the MetricsSampler/watchdog cadence in seconds
+    metrics_port: int | None = None
+    obs_sample_s: float | None = None
     # launcher-side auto-resume (resilience.supervisor): an int arms
     # Job.supervise_run() with that many whole-pod relaunch waves per
     # rolling 600 s window (true = the default budget of 3); a dict
@@ -84,6 +90,8 @@ class JobConfig:
               "coord_timeout_s": (int, float, type(None)),
               "obs_dir": (str, type(None)),
               "serve_port": (int, type(None)),
+              "metrics_port": (int, type(None)),
+              "obs_sample_s": (int, float, type(None)),
               "supervise": (int, bool, dict, type(None))}
 
     @classmethod

@@ -100,7 +100,8 @@ class Job:
                  remote_root="~/jobs", python="python3", dry_run=False,
                  retries=2, retry_backoff=0.5, launch_retries=0,
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
-                 serve_port=None, supervise=None):
+                 serve_port=None, supervise=None, metrics_port=None,
+                 obs_sample_s=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -179,6 +180,16 @@ class Job:
         # the same operator-chosen port on every host — one launch-config
         # knob turns a training job descriptor into a serving-job one
         self.serve_port = None if serve_port is None else int(serve_port)
+        # metrics_port: when set, every host's env gets DK_METRICS_PORT
+        # and its training/serving process brings up the standalone
+        # Prometheus exporter (observability.prometheus) on that port —
+        # one scrape config covers the whole pod.  obs_sample_s exports
+        # DK_OBS_SAMPLE_S, arming the per-host MetricsSampler (time
+        # series + anomaly watchdog) at that cadence.
+        self.metrics_port = (None if metrics_port is None
+                             else int(metrics_port))
+        self.obs_sample_s = (None if obs_sample_s is None
+                             else float(obs_sample_s))
         # supervise: arm supervise_run()'s pod-relaunch budget.
         # int N = N relaunch WAVES per rolling 600 s window; a dict
         # gives the full knobs {"max_restarts", "budget_window_s",
@@ -288,6 +299,12 @@ class Job:
         if self.serve_port is not None:
             # serving plane: ServingServer(port=None) binds this
             env["DK_SERVE_PORT"] = str(self.serve_port)
+        if self.metrics_port is not None:
+            # scrape plane: the per-host Prometheus exporter binds this
+            env["DK_METRICS_PORT"] = str(self.metrics_port)
+        if self.obs_sample_s is not None:
+            # live-telemetry cadence: MetricsSampler + watchdog per host
+            env["DK_OBS_SAMPLE_S"] = str(self.obs_sample_s)
         if session is not None:
             env["DK_COORD_SESSION"] = str(session)
         return env
@@ -597,6 +614,9 @@ class Job:
             CrashLoop,
             RestartBudget,
         )
+        from dist_keras_tpu.resilience.supervisor import (
+            alert as supervisor_alert,
+        )
 
         if self.supervise is None:
             raise ValueError(
@@ -671,6 +691,11 @@ class Job:
                                       for r, h in dead)
                     if not budget.record("hosts_dead", names):
                         events.emit(
+                            "supervisor_giveup", reason="crash_loop",
+                            ranks=[r for r, _ in dead],
+                            restarts_in_window=len(budget.evidence),
+                            window_s=budget.window_s)
+                        supervisor_alert(
                             "supervisor_giveup", reason="crash_loop",
                             ranks=[r for r, _ in dead],
                             restarts_in_window=len(budget.evidence),

@@ -22,12 +22,29 @@ the host and phase that stalled instead of silence:
   ``jax.profiler.TraceAnnotation`` while a device trace is active.
 - :mod:`~dist_keras_tpu.observability.report` — merge per-host logs
   into one (time, rank)-ordered timeline with per-phase summaries;
-  also the CLI: ``python -m dist_keras_tpu.observability <dir>``.
+  also the CLI: ``python -m dist_keras_tpu.observability <dir>``
+  (``--perf`` adds the perf-attribution + watchdog section).
+- :mod:`~dist_keras_tpu.observability.timeseries` — bounded per-metric
+  ``(t, value)`` rings sampled from the registry by a background
+  ``MetricsSampler`` at ``DK_OBS_SAMPLE_S`` — post-mortem snapshots
+  grown into a live, queryable signal.
+- :mod:`~dist_keras_tpu.observability.perf` — always-on CPU-measurable
+  perf attribution: jit retrace/trace counts, dispatch counts, H2D/D2H
+  bytes+walls, per-phase (data/step/comm/ckpt) host wall histograms.
+- :mod:`~dist_keras_tpu.observability.watchdog` — declarative anomaly
+  rules over the time series (step-time regression, throughput stall,
+  queue growth, quiet hosts) -> typed ``watchdog_alert`` events + the
+  ``resilience.supervisor`` alert seam.
+- :mod:`~dist_keras_tpu.observability.prometheus` — text exposition of
+  the registry; serving ``/metricsz?format=prometheus`` and the
+  standalone per-host ``DK_METRICS_PORT`` exporter serve it.
 
 See the README "Observability" section for the env knobs
 (``DK_OBS_DIR`` / ``DK_OBS_FLUSH``), the event schema table and CLI
 examples.
 """
+
+import importlib
 
 from dist_keras_tpu.observability import events, metrics, report, spans
 from dist_keras_tpu.observability.events import (
@@ -42,12 +59,49 @@ from dist_keras_tpu.observability.metrics import (
     gauge,
     histogram,
     snapshot,
+    to_prometheus,
 )
 from dist_keras_tpu.observability.spans import span
 
+# the telemetry plane (sampler thread, watchdog rules, http exposition)
+# resolves lazily: every process imports `events` at startup — through
+# checkpoint/faults/retry — and must not pay for numpy rule math or
+# http.server unless it actually arms the sampler or an exporter
+_LAZY = {
+    "perf": "dist_keras_tpu.observability.perf",
+    "prometheus": "dist_keras_tpu.observability.prometheus",
+    "timeseries": "dist_keras_tpu.observability.timeseries",
+    "watchdog": "dist_keras_tpu.observability.watchdog",
+    "Exporter": ("dist_keras_tpu.observability.prometheus", "Exporter"),
+    "MetricsSampler": ("dist_keras_tpu.observability.timeseries",
+                       "MetricsSampler"),
+    "TimeSeries": ("dist_keras_tpu.observability.timeseries",
+                   "TimeSeries"),
+    "Watchdog": ("dist_keras_tpu.observability.watchdog", "Watchdog"),
+}
+
+
+def __getattr__(name):
+    spec = _LAZY.get(name)
+    if spec is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    if isinstance(spec, tuple):
+        value = getattr(importlib.import_module(spec[0]), spec[1])
+    else:
+        value = importlib.import_module(spec)
+    globals()[name] = value  # resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
 __all__ = [
-    "events", "metrics", "report", "spans",
+    "events", "metrics", "perf", "prometheus", "report", "spans",
+    "timeseries", "watchdog",
     "EventWriter", "emit", "enabled", "obs_dir",
     "counter", "gauge", "histogram", "snapshot", "emit_snapshot",
-    "span",
+    "to_prometheus", "span",
+    "TimeSeries", "MetricsSampler", "Watchdog", "Exporter",
 ]

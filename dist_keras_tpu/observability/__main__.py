@@ -7,6 +7,10 @@
   python -m dist_keras_tpu.observability /path/to/obs_dir --json
   python -m dist_keras_tpu.observability /path/to/obs_dir --json --raw
 
+  # perf attribution: retraces/dispatches/transfers per rank, the
+  # data/step/comm/ckpt host-wall breakdown, watchdog alerts
+  python -m dist_keras_tpu.observability /path/to/obs_dir --perf
+
 Point it at the directory a run exported as ``DK_OBS_DIR`` (for a pod
 job launched with ``Job(obs_dir=...)``, the launcher's
 ``collect_obs(dest)`` rsyncs every host's directory back first).
@@ -38,15 +42,26 @@ def main(argv=None):
     ap.add_argument("--raw", action="store_true",
                     help="with --json: print the full merged event "
                          "timeline instead of the summary")
+    ap.add_argument("--perf", action="store_true",
+                    help="append the perf-attribution section: per-"
+                         "rank retrace/dispatch/transfer totals, the "
+                         "data/step/comm/ckpt host-wall breakdown, "
+                         "and every watchdog alert in the timeline "
+                         "(with --json: a 'perf' key on the summary)")
     args = ap.parse_args(argv)
 
     events = report.read_events(args.obs_dir)
     if args.json:
         doc = events if args.raw else report.summarize(events)
+        if args.perf and not args.raw:
+            doc["perf"] = report.perf_summary(events)
         json.dump(doc, sys.stdout, indent=1, default=str)
         print()
     else:
         print(report.render(args.obs_dir, last_n=args.last))
+        if args.perf:
+            print()
+            print(report.render_perf(args.obs_dir, events=events))
     return 0 if events else 1
 
 

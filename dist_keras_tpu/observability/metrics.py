@@ -114,6 +114,16 @@ class Histogram:
         with self._lock:
             return list(self._window)
 
+    def totals(self):
+        """-> {count, total, max} — the exact lifetime aggregates,
+        WITHOUT the percentile pass (no window copy, no numpy).  The
+        sampler's per-tick path: at a sub-second ``DK_OBS_SAMPLE_S``
+        cadence the full :meth:`summary` per histogram per tick is
+        what would break the <5% overhead contract."""
+        with self._lock:
+            return {"count": self._count, "total": self._total,
+                    "max": self._max}
+
     def summary(self):
         """-> {count, mean, p50, p95, p99, max, total}; a zero-length
         window returns ``count: 0`` with ``None`` stats (``total: 0.0``)
@@ -167,10 +177,14 @@ def histogram(name):
     return _get(str(name), Histogram)
 
 
-def snapshot():
+def snapshot(percentiles=True):
     """-> JSON-ready dict of every registered instrument's current
     value: ``{"counters": {...}, "gauges": {...}, "histograms":
-    {name: summary}}``."""
+    {name: summary}}``.  ``percentiles=False`` swaps each histogram's
+    full summary for its cheap :meth:`Histogram.totals` (count/total/
+    max only) — the sampler-tick variant, O(instruments) with no numpy
+    pass, so a sub-second sampling cadence stays inside the <5%
+    overhead contract."""
     with _lock:
         items = list(_registry.items())
     out = {"counters": {}, "gauges": {}, "histograms": {}}
@@ -180,7 +194,8 @@ def snapshot():
         elif isinstance(inst, Gauge):
             out["gauges"][name] = inst.value
         else:
-            out["histograms"][name] = inst.summary()
+            out["histograms"][name] = (inst.summary() if percentiles
+                                       else inst.totals())
     return out
 
 
@@ -192,6 +207,17 @@ def emit_snapshot(**extra):
     if not events.enabled():
         return
     events.emit("metrics", **snapshot(), **extra)
+
+
+def to_prometheus(**kw):
+    """Prometheus text exposition (format 0.0.4) of the registry — the
+    one scrape format the serving ``/metricsz?format=prometheus``
+    endpoint and the standalone per-host exporter both serve.  Kwargs
+    pass through to :func:`observability.prometheus.render` (lazy
+    import keeps this module http-free)."""
+    from dist_keras_tpu.observability import prometheus
+
+    return prometheus.render(**kw)
 
 
 def reset():

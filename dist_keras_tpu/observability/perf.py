@@ -1,0 +1,169 @@
+"""Perf attribution — CPU-measurable proxies for device-side perf claims.
+
+The device bench has been unresponsive since round 5 (BENCH_r05.json:
+probe timeout), which left every device-only perf claim unattributable.
+This layer records what the HOST can always measure, cheaply enough to
+stay on in production (<5% of train wall, gated):
+
+- **Retraces** — every XLA executable build, counted via a
+  ``jax.monitoring`` duration listener on
+  ``/jax/core/compile/backend_compile_duration`` (plus ``perf.traces``
+  for jaxpr traces and a ``perf.compile_s`` histogram).  Steady-state
+  training and a ladder-bounded serving engine should both read ZERO
+  after warm-up; a nonzero rate in the time series is the "why did this
+  run get slow" answer no wall clock gives.
+- **Dispatches** — compiled-program launches enqueued by the
+  framework's own hot loops (:func:`count_dispatch` at the
+  ``ChunkRunner`` chunk dispatch and each serving replica batch).  A
+  deliberate seam count, not an XLA-internal hook: it measures the
+  dispatch *granularity the framework chose*, which is exactly the knob
+  chunk plans and batch ladders turn.
+- **H2D / D2H bytes + walls** — :func:`h2d` at the ``ChunkFeed``
+  transfer (bytes shipped + the async enqueue wall) and :func:`d2h` at
+  the trainers' blocking loss retire (bytes fetched + the blocking
+  wall, which on the streamed path is the documented backpressure
+  barrier — the honest "host overlap wall").
+- **Per-phase step-time breakdown** — :func:`phase` wraps the dispatch
+  loop's host-side phases (``data`` / ``step`` / ``comm`` / ``ckpt``)
+  into always-on ``perf.phase.<name>`` registry histograms.  The time
+  domain rides the sampler's ``perf_sample`` events, NOT per-call span
+  events: phases run at per-chunk cadence, and two JSON lines per phase
+  per chunk is exactly the hot-loop emission volume the <5% overhead
+  contract forbids (measured: it tripled the obs gate's emit wall).
+  While a device trace is open the region still goes through
+  ``spans.span`` — so XProf annotations and the histograms share one
+  vocabulary when it matters, at a cadence an operator opted into.
+
+Everything lands in the process metrics registry, so it rides the
+epoch-boundary snapshots, the ``MetricsSampler`` time series, the
+``perf_sample`` events, and the Prometheus exposition with no extra
+plumbing.  No device profiler is ever required.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from dist_keras_tpu.observability import metrics, spans
+
+# one executable build per fire — the retrace proxy
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# one jaxpr trace per fire — the (noisier) Python-side tracing proxy
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+_lock = threading.Lock()
+_installed = False
+
+PHASES = ("data", "step", "comm", "ckpt")
+
+
+def _on_duration(name, duration_secs, **kw):
+    if name == _COMPILE_EVENT:
+        metrics.counter("perf.retraces").inc()
+        metrics.histogram("perf.compile_s").observe(duration_secs)
+    elif name == _TRACE_EVENT:
+        metrics.counter("perf.traces").inc()
+
+
+def install():
+    """Register the retrace listener (idempotent; one module flag check
+    per call, so hot loops may call it freely).  -> True when the
+    listener is active, False when jax/monitoring is unavailable —
+    callers never gate on the result, the counters just stay zero."""
+    global _installed
+    if _installed:
+        return True
+    with _lock:
+        if _installed:
+            return True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration)
+        except Exception:
+            return False
+        _installed = True
+    return True
+
+
+def installed():
+    return _installed
+
+
+def count_dispatch(n=1):
+    """Count ``n`` compiled-program launches enqueued by a framework
+    hot loop (per chunk / per serving batch — NOT per compiled step,
+    which lives inside the dispatch and cannot host a Python hook)."""
+    metrics.counter("perf.dispatches").inc(n)
+
+
+def h2d(nbytes, seconds):
+    """Record one host->device transfer: bytes shipped + the enqueue
+    wall (``device_put`` is async — the DMA itself overlaps compute by
+    design, so the enqueue wall is the host-side cost that exists)."""
+    metrics.counter("perf.h2d_bytes").inc(int(nbytes))
+    metrics.histogram("perf.h2d_s").observe(seconds)
+
+
+def d2h(nbytes, seconds):
+    """Record one device->host fetch: bytes + the BLOCKING wall.  On
+    the streamed training path this wall doubles as the depth-2
+    backpressure barrier (see ``ChunkRunner``), so it includes the wait
+    for the dispatched compute — which is precisely the "host overlap
+    wall" a device-only claim needs a CPU-measurable proxy for."""
+    metrics.counter("perf.d2h_bytes").inc(int(nbytes))
+    metrics.histogram("perf.d2h_s").observe(seconds)
+
+
+@contextlib.contextmanager
+def phase(name, **fields):
+    """Always-on timed phase: observes ``perf.phase.<name>`` (registry
+    histogram — a clock read + deque append, no I/O, per-chunk-cadence
+    safe).  Only while a device trace is open does the region also run
+    through ``spans.span`` (-> ``TraceAnnotation`` + span events), so
+    XProf and the histograms share a vocabulary without per-chunk JSON
+    emission on production runs."""
+    cm = (spans.span(f"perf.{name}", **fields)
+          if spans.device_trace_active() else contextlib.nullcontext())
+    with cm:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            metrics.histogram(f"perf.phase.{name}").observe(
+                time.perf_counter() - t0)
+
+
+def snapshot(snap=None):
+    """Compact JSON-ready perf-attribution snapshot — the
+    ``perf_sample`` event payload and the report's per-rank row.
+    Percentile-free (totals only): this runs on every sampler tick,
+    which passes its already-taken registry ``snap`` in so one tick
+    walks the registry once, not twice."""
+    if snap is None:
+        snap = metrics.snapshot(percentiles=False)
+    counters, hists = snap["counters"], snap["histograms"]
+    phases = {}
+    for name, h in hists.items():
+        if name.startswith("perf.phase."):
+            phases[name[len("perf.phase."):]] = {
+                "count": h["count"],
+                "total_s": round(h["total"], 6),
+                "mean_s": (round(h["total"] / h["count"], 6)
+                           if h["count"] else None),
+            }
+    out = {
+        "retraces": counters.get("perf.retraces", 0),
+        "traces": counters.get("perf.traces", 0),
+        "dispatches": counters.get("perf.dispatches", 0),
+        "h2d_bytes": counters.get("perf.h2d_bytes", 0),
+        "d2h_bytes": counters.get("perf.d2h_bytes", 0),
+        "phases": phases,
+    }
+    compile_h = hists.get("perf.compile_s")
+    if compile_h and compile_h["count"]:
+        out["compile_s_total"] = round(compile_h["total"], 4)
+    return out

@@ -185,6 +185,115 @@ def summarize(events):
     }
 
 
+def perf_summary(events):
+    """-> perf-attribution view of a merged timeline: per-rank retrace/
+    dispatch/transfer totals + per-phase host-wall breakdown (from each
+    rank's LAST registry snapshot, falling back to its last
+    ``perf_sample``), plus every ``watchdog_alert``/``watchdog_clear``
+    in timeline order.  The CLI's ``--perf`` section."""
+    last_metrics = {}   # rank -> last "metrics" registry snapshot
+    last_sample = {}    # rank -> last "perf_sample" payload
+    alerts, clears = [], []
+    for ev in events:
+        rank = int(ev.get("rank", 0))
+        kind = ev.get("kind")
+        if kind == "metrics":
+            last_metrics[rank] = ev
+        elif kind == "perf_sample":
+            last_sample[rank] = ev
+        elif kind == "watchdog_alert":
+            alerts.append(ev)
+        elif kind == "watchdog_clear":
+            clears.append(ev)
+    per_rank = {}
+    for rank in sorted(set(last_metrics) | set(last_sample)):
+        snap = last_metrics.get(rank)
+        samp = last_sample.get(rank)
+        # take whichever record is NEWER: a process that trains and
+        # then serves keeps emitting perf_sample long after its last
+        # epoch-boundary snapshot — preferring the snapshot
+        # unconditionally would freeze --perf at train-end totals
+        if snap is not None and samp is not None \
+                and samp.get("t", 0.0) > snap.get("t", 0.0):
+            snap = None
+        if snap is not None:
+            counters = snap.get("counters", {}) or {}
+            hists = snap.get("histograms", {}) or {}
+            phases = {}
+            for name, h in hists.items():
+                if not name.startswith("perf.phase."):
+                    continue
+                count = h.get("count", 0) or 0
+                total = h.get("total", 0.0) or 0.0
+                phases[name[len("perf.phase."):]] = {
+                    "count": count, "total_s": round(total, 4),
+                    "mean_s": (round(total / count, 6) if count
+                               else None)}
+            per_rank[rank] = {
+                "retraces": counters.get("perf.retraces", 0),
+                "dispatches": counters.get("perf.dispatches", 0),
+                "h2d_bytes": counters.get("perf.h2d_bytes", 0),
+                "d2h_bytes": counters.get("perf.d2h_bytes", 0),
+                "phases": phases,
+            }
+        else:  # no epoch boundary, or the sampler ran past the last one
+            s = last_sample[rank]
+            per_rank[rank] = {
+                "retraces": s.get("retraces", 0),
+                "dispatches": s.get("dispatches", 0),
+                "h2d_bytes": s.get("h2d_bytes", 0),
+                "d2h_bytes": s.get("d2h_bytes", 0),
+                "phases": s.get("phases", {}) or {},
+            }
+    return {"per_rank": per_rank, "watchdog_alerts": alerts,
+            "watchdog_clears": clears}
+
+
+def render_perf(directory, events=None):
+    """Human-readable perf/watchdog section for ``--perf``."""
+    if events is None:
+        events = read_events(directory)
+    p = perf_summary(events)
+    lines = ["# perf attribution"]
+    if not p["per_rank"] and not p["watchdog_alerts"]:
+        lines.append("no perf telemetry recorded (retrace/dispatch "
+                     "counters ride registry snapshots — was the run "
+                     "instrumented with DK_OBS_DIR, and did it reach "
+                     "an epoch boundary or a perf_sample tick?)")
+        return "\n".join(lines)
+    for rank in sorted(p["per_rank"]):
+        row = p["per_rank"][rank]
+        lines.append(
+            f"rank {rank}: retraces={row['retraces']} "
+            f"dispatches={row['dispatches']} "
+            f"h2d={row['h2d_bytes']}B d2h={row['d2h_bytes']}B")
+        for name in ("data", "step", "comm", "ckpt"):
+            ph = row["phases"].get(name)
+            if not ph:
+                continue
+            mean = ph.get("mean_s")
+            lines.append(
+                f"  phase {name}: n={ph.get('count', 0)} "
+                f"total={ph.get('total_s', 0.0):.3f}s"
+                + (f" mean={mean * 1e3:.2f}ms" if mean else ""))
+    t0 = events[0].get("t", 0.0) if events else 0.0
+    if p["watchdog_alerts"]:
+        lines.append("watchdog alerts:")
+        for a in p["watchdog_alerts"]:
+            ts = a.get("t", 0.0)
+            extras = _fmt_fields(
+                a, skip=("t", "seq", "rank", "kind", "rule"))
+            lines.append(f"  +{ts - t0:9.3f}s rank {a.get('rank', 0)} "
+                         f"{a.get('rule', '?')}: {extras}")
+        for c in p["watchdog_clears"]:
+            ts = c.get("t", 0.0)
+            lines.append(f"  +{ts - t0:9.3f}s rank {c.get('rank', 0)} "
+                         f"{c.get('rule', '?')}: cleared")
+    else:
+        lines.append("watchdog alerts: none")
+    return "\n".join(lines)
+
+
 def _fmt_fields(ev, skip=("t", "seq", "rank", "kind")):
     parts = []
     for k, v in ev.items():

@@ -46,9 +46,11 @@ subprocess tests inherit the schedule:
     DK_FAULTS="checkpoint.save@1;job.rsync@0x2:action=replace,value=30"
 
 Grammar per semicolon-separated entry: ``point[@at][xN][:k=v,...]`` with
-keys ``action`` (raise|corrupt|replace), ``exc`` (FaultInjected, OSError,
-IOError, ValueError, RuntimeError, ConnectionError, TimeoutError) and
-``value`` (float for replace).
+keys ``action`` (raise|corrupt|replace|delay), ``exc`` (FaultInjected,
+OSError, IOError, ValueError, RuntimeError, ConnectionError,
+TimeoutError) and ``value`` (float: the replacement for ``replace``,
+the sleep seconds for ``delay`` — a slow-not-dead seam, what the
+``gates.py --watchdog-only`` slow-step injection arms).
 
 CHAOS MODE (this PR): ``DK_FAULTS_SEED=<int>`` arms every registered
 fault point (:data:`KNOWN_POINTS`) with a SEEDED random schedule —
@@ -116,7 +118,7 @@ class FaultSpec:
 
     def __init__(self, point, at=0, times=1, action="raise", exc=None,
                  value=None):
-        if action not in ("raise", "corrupt", "replace"):
+        if action not in ("raise", "corrupt", "replace", "delay"):
             raise ValueError(f"unknown fault action {action!r}")
         self.point = str(point)
         self.at = int(at)
@@ -142,7 +144,9 @@ def inject(point, at=0, times=1, action="raise", exc=None, value=None):
 
     ``action``: ``"raise"`` raises ``exc`` (default :class:`FaultInjected`);
     ``"corrupt"`` returns a NaN-poisoned copy of the value passed to
-    :func:`fault_point`; ``"replace"`` returns ``value`` instead of it.
+    :func:`fault_point`; ``"replace"`` returns ``value`` instead of it;
+    ``"delay"`` sleeps ``value`` seconds then passes the value through
+    untouched (a slow seam — the watchdog-gate injection).
     Returns the :class:`FaultSpec` (pass to :func:`disarm`, or
     :func:`clear` everything).
     """
@@ -376,6 +380,16 @@ def fault_point(name, value=_MISSING):
     if spec.action == "raise":
         raise spec.exc(
             f"fault injected at point {name!r} (call #{count})")
+    if spec.action == "delay":
+        # a SLOW seam, not a dead one: stall this call for value
+        # seconds, then pass the value through untouched — the
+        # deterministic "this rank got slow" injection the perf
+        # watchdog gate drives (a raise would end the run instead of
+        # degrading it)
+        import time
+
+        time.sleep(float(spec.value or 0.0))
+        return None if value is _MISSING else value
     if spec.action == "replace":
         return spec.value
     # corrupt

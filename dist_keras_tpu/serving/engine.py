@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 
 from dist_keras_tpu.data.streaming import pack_rows
-from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.observability import events, metrics, perf
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.utils.serialization import (
     deserialize_model,
@@ -206,6 +206,12 @@ class ServingEngine:
         self._reg_rejected = metrics.counter("serve.rejected")
         self._reg_errors = metrics.counter("serve.errors")
         self._reg_predict = metrics.histogram("serve.predict_s")
+        # live queue-depth gauge: the watchdog's queue-growth rule and
+        # the future router both read this as a time series — updated
+        # at admission and at every resolution (last engine wins when
+        # two coexist, which matches "the serving load on this host")
+        self._reg_pending = metrics.gauge("serve.pending")
+        perf.install()  # retrace listener: the ladder bound, verified
 
         self._replica_threads = [threading.Thread(
             target=self._replica_loop, args=(rep,), daemon=True,
@@ -250,6 +256,10 @@ class ServingEngine:
             self._outstanding += 1
             self._n_enqueued += 1
             pending = len(self._pending)
+            # gauge set INSIDE the lock: set outside, a descheduled
+            # updater could overwrite a newer depth with its stale one
+            # and the serve.pending series would mask a growing queue
+            self._reg_pending.set(self._outstanding)
             self._cond.notify()
         self._reg_enqueued.inc()
         # NOTE: the subsystem's only per-request event — with DK_OBS_DIR
@@ -331,6 +341,7 @@ class ServingEngine:
                 with self._cond:
                     self._n_errors += len(take)
                     self._outstanding -= len(take)
+                    self._reg_pending.set(self._outstanding)
                     self._inflight -= 1
                     self._cond.notify_all()
                 self._reg_errors.inc(len(take))
@@ -359,6 +370,7 @@ class ServingEngine:
             t0 = time.perf_counter()
             try:
                 fault_point("serve.predict")
+                perf.count_dispatch()  # one compiled launch per batch
                 xb = jnp.asarray(x)
                 if rep.device is not None:
                     xb = jax.device_put(xb, rep.device)
@@ -369,6 +381,7 @@ class ServingEngine:
                 with self._cond:
                     self._n_errors += len(reqs)
                     self._outstanding -= len(reqs)
+                    self._reg_pending.set(self._outstanding)
                 self._reg_errors.inc(len(reqs))
                 events.emit("serve_predict_error", replica=rep.index,
                             n=len(reqs), error=type(e).__name__)
@@ -381,6 +394,7 @@ class ServingEngine:
                     self._n_batches += 1
                     self._n_completed += len(reqs)
                     self._outstanding -= len(reqs)
+                    self._reg_pending.set(self._outstanding)
                 self._reg_completed.inc(len(reqs))
                 self._m_predict.observe(dt)
                 self._reg_predict.observe(dt)
@@ -485,6 +499,7 @@ class ServingEngine:
             pending, self._pending = list(self._pending), \
                 collections.deque()
             self._outstanding -= len(pending)
+            self._reg_pending.set(self._outstanding)
             self._n_rejected += len(pending)
             self._cond.notify_all()
         self._reg_rejected.inc(len(pending))

@@ -66,9 +66,13 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, code, payload, retry_after=None):
-        body = json.dumps(payload).encode("utf-8")
+        self._reply_text(code, json.dumps(payload), "application/json",
+                         retry_after=retry_after)
+
+    def _reply_text(self, code, text, content_type, retry_after=None):
+        body = text.encode("utf-8")
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
@@ -77,7 +81,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         srv = self.server
-        if self.path.split("?")[0] == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             if srv.engine.draining or not srv.engine.running:
                 self._reply(503, {"status": "draining"})
             else:
@@ -85,9 +90,26 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, {"status": "serving",
                                   "replicas": st["replicas"],
                                   "pending": st["pending"]})
-        elif self.path.split("?")[0] == "/metricsz":
-            self._reply(200, {"engine": srv.engine.stats(),
-                              "registry": _metrics.snapshot()})
+        elif path == "/metricsz":
+            if "format=prometheus" in query:
+                # the scrape-plane view: registry exposition + the
+                # engine's numeric stats as dk_serve_engine_* gauges,
+                # text format 0.0.4 — the same rendering the standalone
+                # per-host exporter serves, so a router/Prometheus
+                # scrapes one vocabulary everywhere
+                from dist_keras_tpu.observability import prometheus
+
+                extras = {
+                    f"serve.engine.{k}": v
+                    for k, v in srv.engine.stats().items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+                self._reply_text(
+                    200, prometheus.render(extra_gauges=extras),
+                    prometheus.CONTENT_TYPE)
+            else:
+                self._reply(200, {"engine": srv.engine.stats(),
+                                  "registry": _metrics.snapshot()})
         else:
             self._reply(404, {"error": "not_found", "path": self.path})
 
@@ -201,6 +223,13 @@ class ServingServer(ThreadingHTTPServer):
     def start(self):
         """Serve on a background thread (tests / notebook use);
         -> (host, port)."""
+        # live-telemetry plane: with DK_OBS_SAMPLE_S set, the sampler
+        # (time series + watchdog — incl. the serve.pending queue-growth
+        # rule) and the DK_METRICS_PORT exporter come up with the
+        # server; one env read when unset
+        from dist_keras_tpu.observability import timeseries
+
+        timeseries.maybe_start_sampler()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="dk-serve-http")
         self._thread.start()
@@ -233,12 +262,23 @@ class ServingServer(ThreadingHTTPServer):
         failure), never a connection parked in an unserviced backlog."""
         out = self.engine.drain(timeout_s=timeout_s)
         self._stop_listener()  # in-flight handler threads still finish
+        # deliberate completion: the serve.* counters stop advancing
+        # now — quiesce the watchdog so drained-quiet is not judged a
+        # throughput stall by the still-running sampler
+        from dist_keras_tpu.observability import timeseries
+
+        sampler = timeseries.get_sampler()
+        if sampler is not None and sampler.watchdog is not None:
+            sampler.watchdog.quiesce()
         return out
 
     def run_forever(self):
         """Serve on the CALLING thread until stopped.  After a
         signal-initiated drain, re-raises :class:`Preempted` so the
         process exits ``128+signum`` (scheduler convention)."""
+        from dist_keras_tpu.observability import timeseries
+
+        timeseries.maybe_start_sampler()  # same wiring as start()
         try:
             self.serve_forever()
         finally:

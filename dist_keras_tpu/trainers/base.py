@@ -102,18 +102,33 @@ class Trainer:
     # ---- timing (trainers.py:~60) ----
     def record_training_start(self):
         self._t_start = time.time()
-        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.observability import events, timeseries
 
         events.emit("train_start", trainer=type(self).__name__,
                     num_epoch=self.num_epoch,
                     batch_size=self.batch_size)
+        # live-telemetry plane: with DK_OBS_SAMPLE_S set this arms the
+        # per-process MetricsSampler (time-series rings + anomaly
+        # watchdog) and the DK_METRICS_PORT Prometheus exporter; one
+        # env read when unset.  Deliberately NOT stopped at train end —
+        # the series/watchdog keep covering whatever the process does
+        # next (another train, a serving phase), like the registry.
+        timeseries.maybe_start_sampler()
 
     def record_training_end(self):
         self._t_stop = time.time()
-        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.observability import events, timeseries
 
         events.emit("train_end", trainer=type(self).__name__,
                     seconds=self.get_training_time())
+        # the sampler keeps running (see record_training_start), but
+        # the watchdog must learn this quiet is COMPLETION: without a
+        # quiesce, the dispatch counter stopping at train end reads as
+        # a throughput stall and pages the operator for a run that
+        # succeeded
+        sampler = timeseries.get_sampler()
+        if sampler is not None and sampler.watchdog is not None:
+            sampler.watchdog.quiesce()
         # leader-side merged report: when the obs dir is shared
         # storage, rank 0 leaves report.txt next to the logs at run
         # end — the post-hoc CLI remains for collected/per-host dirs.

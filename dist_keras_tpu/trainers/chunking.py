@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from dist_keras_tpu.observability import events as obs_events
+from dist_keras_tpu.observability import perf
 from dist_keras_tpu.resilience import coordination, preemption
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.guards import check_losses
@@ -308,12 +309,19 @@ class ChunkRunner:
         def _retire_one():
             # the blocking fetch doubles as the backpressure barrier —
             # see the class docstring for why a drain + deferred fetch
-            # is NOT cheaper here
+            # is NOT cheaper here.  perf attribution: the fetch wall is
+            # the host-side "step" phase (it blocks on the dispatched
+            # compute) and the fetched bytes are the D2H proxy row; the
+            # step.loss fault stays INSIDE the phase so an injected
+            # delay (gates.py --watchdog-only) reads as a slow step.
             j, lj, units_after = pending.pop(0)
-            arr = np.asarray(self._fetch(lj))  # blocks until chunk j done
-            # deterministic NaN injection rides the fetched host array
-            # (device math untouched) — the nan_policy test hook
-            arr = fault_point("step.loss", value=arr)
+            with perf.phase("step"):
+                t_fetch = time.perf_counter()
+                arr = np.asarray(self._fetch(lj))  # blocks: chunk j done
+                perf.d2h(arr.nbytes, time.perf_counter() - t_fetch)
+                # deterministic NaN injection rides the fetched host
+                # array (device math untouched) — the nan_policy hook
+                arr = fault_point("step.loss", value=arr)
             if self.feed is not None:
                 self.feed.release(j)
             all_losses.append(arr)
@@ -344,6 +352,11 @@ class ChunkRunner:
         # the preemption VOTE is gated on handle_preemption (a config
         # every host shares, so the collective op order stays SPMD).
         coord = coordination.get_coordinator()
+        # perf attribution (observability.perf): retrace listener on,
+        # phases + dispatch counts below — always-on host-side proxies
+        # for the device-only perf story (one flag check when already
+        # installed)
+        perf.install()
         tr.record_training_start()
         t_mark = time.time()
         try:
@@ -358,10 +371,13 @@ class ChunkRunner:
                     # boundary vote: did ANY host see the signal?  A
                     # host whose own flag is clear adopts SIGTERM — its
                     # scheduler's signal is merely in flight.
-                    if coord.any_flag(sig is not None):
+                    with perf.phase("comm"):
+                        voted = coord.any_flag(sig is not None)
+                    if voted:
                         sig = signal.SIGTERM if sig is None else sig
                     if sig is not None and coord.world > 1:
-                        agreed = coord.agree_min(units_done)
+                        with perf.phase("comm"):
+                            agreed = coord.agree_min(units_done)
                         if agreed != units_done:  # pragma: no cover
                             # identical plans + the same vote boundary
                             # make this impossible unless hosts diverged
@@ -399,15 +415,18 @@ class ChunkRunner:
                         # out the whole deadline on a marker that never
                         # comes.  Either every host saves or none does.
                         self._halt = coord.any_flag(self._halt)
-                    saved = (None if self._halt
-                             else self._preempt_save(units_done, state_fn,
-                                                     world=coord.world))
+                    with perf.phase("ckpt"):
+                        saved = (None if self._halt
+                                 else self._preempt_save(
+                                     units_done, state_fn,
+                                     world=coord.world))
                     if coord.world > 1:
                         # every host's save (incl. the leader's
                         # promotion) lands before ANY host exits — the
                         # scheduler restarts a pod whose checkpoint is
                         # fully committed, never torn
-                        coord.barrier("preempt_exit")
+                        with perf.phase("comm"):
+                            coord.barrier("preempt_exit")
                     obs_events.emit("preempt_exit", signum=int(sig),
                                     saved_step=saved)
                     # the run ENDED here: stamp the wall clock (the
@@ -418,9 +437,12 @@ class ChunkRunner:
                     # ABNORMAL exits, not only clean completions
                     tr.record_training_end()
                     raise Preempted(sig, saved_step=saved)
-                data = (self.feed.get(i) if self.feed is not None
-                        else resident_data)
-                losses = dispatch(i, K, units_done, data)
+                with perf.phase("data"):
+                    data = (self.feed.get(i) if self.feed is not None
+                            else resident_data)
+                with perf.phase("step"):
+                    losses = dispatch(i, K, units_done, data)
+                perf.count_dispatch()
                 units_done += K
                 # per-CHUNK (not per-step — steps live inside the
                 # compiled scan) breadcrumb: the last of these in a
@@ -436,7 +458,8 @@ class ChunkRunner:
                     # overlaps chunk i's execution
                     while len(pending) > 1:
                         _retire_one()
-                    self.feed.prefetch(i + 1)
+                    with perf.phase("data"):
+                        self.feed.prefetch(i + 1)
                 multi = coord.world > 1
                 # multi-host: a locally-tripped halt must NOT cut a
                 # boundary only this host sees — every consensus op has
@@ -450,7 +473,8 @@ class ChunkRunner:
                 acc_samples += self.samples_per_unit * K
                 if not boundary:
                     continue
-                drain(sync_ref())  # block_until_ready lies via tunnel
+                with perf.phase("step"):
+                    drain(sync_ref())  # block_until_ready lies via tunnel
                 acc_dt += time.time() - t_mark
                 # host-side work below (loss fetches, checkpoint I/O,
                 # user callbacks) stays OUTSIDE the clock
@@ -461,12 +485,14 @@ class ChunkRunner:
                     # whole pod together (or nobody) — an uncoordinated
                     # break here would leave the peers blocking in their
                     # next vote until the deadline
-                    self._halt = coord.any_flag(self._halt)
+                    with perf.phase("comm"):
+                        self._halt = coord.any_flag(self._halt)
                 # save BEFORE user callbacks run: a callback that dies
                 # (preemption simulation) must not lose the chunk — but
                 # NEVER persist a halted (diverged) run's state
                 if not self._halt:
-                    self._maybe_ckpt(units_done, state_fn)
+                    with perf.phase("ckpt"):
+                        self._maybe_ckpt(units_done, state_fn)
                 if units_done % self.per_epoch == 0:
                     tr._emit_epoch_end(
                         units_done // self.per_epoch,

@@ -1,0 +1,670 @@
+"""Continuous perf telemetry: TimeSeries rings + MetricsSampler,
+perf attribution counters/phases, the anomaly watchdog's rules and
+fire/clear hysteresis, Prometheus exposition, the standalone exporter,
+and the supervisor alert seam (sinks + DK_ALERT_CMD)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.observability import (
+    events,
+    metrics,
+    perf,
+    prometheus,
+    report,
+    timeseries,
+    watchdog,
+)
+from dist_keras_tpu.resilience import supervisor
+from dist_keras_tpu.resilience.supervisor import CrashLoop, supervise
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    """Reset every process-global telemetry registry on the way in AND
+    out — other test files must keep seeing the disabled fast paths."""
+    for k in ("DK_OBS_DIR", "DK_OBS_SAMPLE_S", "DK_OBS_TS_WINDOW",
+              "DK_METRICS_PORT", "DK_WATCHDOG", "DK_ALERT_CMD"):
+        monkeypatch.delenv(k, raising=False)
+    events.reset()
+    metrics.reset()
+    timeseries.reset()
+    prometheus.stop_exporter()
+    supervisor.clear_alert_sinks()
+    yield
+    timeseries.reset()
+    prometheus.stop_exporter()
+    supervisor.clear_alert_sinks()
+    events.reset()
+    metrics.reset()
+
+
+@pytest.fixture
+def obs_dir(tmp_path, monkeypatch):
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    events.reset()
+    yield d
+    events.reset()
+
+
+# ------------------------------------------------------------ TimeSeries
+def test_timeseries_window_bounds_and_order():
+    s = timeseries.TimeSeries("x", window=8)
+    for i in range(100):
+        s.append(float(i), t=1000.0 + i)
+    assert len(s) == 8                      # retained points bounded
+    assert s.total_appended == 100          # lifetime count exact
+    t, v = s.values()
+    assert list(v) == [92.0, 93.0, 94.0, 95.0, 96.0, 97.0, 98.0, 99.0]
+    assert list(t) == [1092.0 + i for i in range(8)]  # chronological
+    assert s.latest == (1099.0, 99.0)
+
+
+def test_timeseries_under_window_and_empty():
+    s = timeseries.TimeSeries("x", window=16)
+    t, v = s.values()
+    assert len(t) == 0 and len(v) == 0 and len(s) == 0
+    assert s.latest is None and s.span_s() == 0.0
+    s.append(1.0, t=10.0)
+    s.append(2.0, t=13.0)
+    t, v = s.values()
+    assert list(v) == [1.0, 2.0] and s.span_s() == 3.0
+    t, v = s.since(12.0)
+    assert list(v) == [2.0]
+
+
+def test_timeseries_window_floor():
+    with pytest.raises(ValueError):
+        timeseries.TimeSeries("x", window=1)
+
+
+def test_timeseries_env_window(monkeypatch):
+    monkeypatch.setenv("DK_OBS_TS_WINDOW", "4")
+    s = timeseries.TimeSeries("x")
+    assert s.window == 4
+    monkeypatch.setenv("DK_OBS_TS_WINDOW", "bogus")
+    assert timeseries.TimeSeries("y").window == timeseries.DEFAULT_WINDOW
+
+
+def test_record_snapshot_folds_registry():
+    metrics.counter("c").inc(3)
+    metrics.gauge("g").set(7.5)
+    metrics.gauge("label").set("not-a-number")
+    metrics.histogram("h").observe(2.0)
+    metrics.histogram("h").observe(4.0)
+    timeseries.record_snapshot(metrics.snapshot(percentiles=False),
+                               t=100.0)
+    assert timeseries.get("c").latest == (100.0, 3.0)
+    assert timeseries.get("g").latest == (100.0, 7.5)
+    # histograms fold to cumulative count/total pairs
+    assert timeseries.get("h.count").latest == (100.0, 2.0)
+    assert timeseries.get("h.total").latest == (100.0, 6.0)
+    # non-numeric gauges never materialize a series
+    assert timeseries.get("label") is None
+
+
+def test_get_probes_without_creating():
+    assert timeseries.get("never-recorded") is None
+    assert "never-recorded" not in timeseries.names()
+    timeseries.series("made")
+    assert timeseries.get("made") is not None
+
+
+def test_snapshot_percentiles_false_skips_numpy_pass():
+    metrics.histogram("h").observe(1.0)
+    h = metrics.snapshot(percentiles=False)["histograms"]["h"]
+    assert h == {"count": 1, "total": 1.0, "max": 1.0}
+    assert "p50" not in h
+
+
+# --------------------------------------------------------------- sampler
+def test_sampler_start_stop_idempotent():
+    s = timeseries.MetricsSampler(interval_s=60.0)
+    assert not s.running
+    assert s.start() is s
+    thread = s._thread
+    s.start()                               # second start: same thread
+    assert s._thread is thread and s.running
+    s.stop()
+    assert not s.running
+    s.stop()                                # second stop: no-op
+    ticks = s.ticks
+    s.stop(final_tick=True)                 # deterministic last pass
+    assert s.ticks == ticks + 1
+
+
+def test_sampler_tick_samples_registry_and_runs_watchdog():
+    checks = []
+
+    class Probe(watchdog.Rule):
+        name = "probe"
+
+        def evaluate(self, now):
+            checks.append(now)
+            return False, {}
+
+    wd = watchdog.Watchdog(rules=[Probe()])
+    s = timeseries.MetricsSampler(interval_s=60.0, watchdog=wd)
+    metrics.counter("ticked").inc(5)
+    s.tick(now=123.0)
+    assert timeseries.get("ticked").latest == (123.0, 5.0)
+    assert checks == [123.0]
+
+
+def test_maybe_start_sampler_env_gated(monkeypatch):
+    assert timeseries.maybe_start_sampler() is None   # unset = off
+    assert timeseries.get_sampler() is None
+    monkeypatch.setenv("DK_OBS_SAMPLE_S", "30")
+    s = timeseries.maybe_start_sampler()
+    assert s is not None and s.running and s.interval_s == 30.0
+    assert s.watchdog is not None           # default watchdog attached
+    assert timeseries.maybe_start_sampler() is s      # idempotent
+    timeseries.stop_sampler()
+    assert timeseries.get_sampler() is None
+
+
+def test_maybe_start_sampler_watchdog_opt_out(monkeypatch):
+    monkeypatch.setenv("DK_OBS_SAMPLE_S", "30")
+    monkeypatch.setenv("DK_WATCHDOG", "0")
+    s = timeseries.maybe_start_sampler()
+    assert s is not None and s.watchdog is None
+
+
+def test_default_sample_s_parsing(monkeypatch):
+    assert timeseries.default_sample_s() is None
+    for raw, want in (("2.5", 2.5), ("bogus", None), ("0", None),
+                      ("-1", None), ("  ", None)):
+        monkeypatch.setenv("DK_OBS_SAMPLE_S", raw)
+        assert timeseries.default_sample_s() == want
+
+
+# -------------------------------------------------------- watchdog rules
+def _seed_phase_series(name="perf.phase.step", base_mean=0.01,
+                       slow_mean=0.1, n_base=11, n_slow=3, per_tick=5):
+    """Cumulative .count/.total rings mimicking sampler ticks at
+    t=0,1,...: n_base intervals at base_mean then n_slow at slow_mean."""
+    sc = timeseries.series(f"{name}.count")
+    st = timeseries.series(f"{name}.total")
+    count, total = 0, 0.0
+    t = 0.0
+    for i in range(n_base + n_slow):
+        sc.append(count, t=t)
+        st.append(total, t=t)
+        mean = base_mean if i < n_base else slow_mean
+        count += per_tick
+        total += per_tick * mean
+        t += 1.0
+    sc.append(count, t=t)
+    st.append(total, t=t)
+    return t                                # the "now" of the last tick
+
+
+def test_step_time_regression_fires_and_names_phase():
+    now = _seed_phase_series()
+    rule = watchdog.StepTimeRegression(factor=2.0, recent_s=3.0,
+                                       min_baseline=3)
+    firing, fields = rule.evaluate(now)
+    assert firing
+    assert fields["phase"] == "step"
+    assert fields["recent_mean_s"] == pytest.approx(0.1, rel=0.2)
+    assert fields["baseline_median_s"] == pytest.approx(0.01, rel=0.2)
+
+
+def test_step_time_regression_quiet_on_steady_run():
+    now = _seed_phase_series(slow_mean=0.01)  # no regression
+    rule = watchdog.StepTimeRegression(factor=2.0, recent_s=3.0,
+                                       min_baseline=3)
+    firing, _ = rule.evaluate(now)
+    assert not firing
+
+
+def test_step_time_regression_absolute_floor():
+    # a 4x "regression" of a sub-ms step is scheduler noise, not an
+    # incident: the min_abs_s floor keeps it quiet...
+    now = _seed_phase_series(base_mean=0.0005, slow_mean=0.002)
+    rule = watchdog.StepTimeRegression(factor=2.0, recent_s=3.0,
+                                       min_baseline=3)
+    assert not rule.evaluate(now)[0]
+    # ...and opting out (min_abs_s=0) restores pure-ratio firing
+    rule = watchdog.StepTimeRegression(factor=2.0, recent_s=3.0,
+                                       min_baseline=3, min_abs_s=0.0)
+    assert rule.evaluate(now)[0]
+
+
+def test_step_time_regression_quiet_without_baseline():
+    now = _seed_phase_series(n_base=2, n_slow=1)  # < min_baseline
+    rule = watchdog.StepTimeRegression(factor=2.0, recent_s=1.5,
+                                       min_baseline=3)
+    firing, _ = rule.evaluate(now)
+    assert not firing
+    # and a metric nobody records never fires
+    assert watchdog.StepTimeRegression(metric="no.such")\
+        .evaluate(now) == (False, {})
+
+
+def test_throughput_stall_fires_then_clears():
+    s = timeseries.series("perf.dispatches")
+    rule = watchdog.ThroughputStall("perf.dispatches", window_s=4.0)
+    fired = {}
+    for i in range(11):                     # advance 0..5 then stall
+        s.append(float(min(i, 5)), t=float(i))
+        fired[i] = rule.evaluate(float(i))[0]
+    # last advance at t=5 -> the 4 s window dies at t=9
+    assert not any(fired[i] for i in range(9))
+    assert fired[9] and fired[10]
+    firing, fields = rule.evaluate(10.0)
+    assert firing and fields["stalled_s"] == pytest.approx(5.0)
+    # resumed progress -> quiet again
+    s.append(6.0, t=11.0)
+    firing, _ = rule.evaluate(11.0)
+    assert not firing
+
+
+def test_throughput_stall_quiet_before_any_advance():
+    s = timeseries.series("serve.completed")
+    rule = watchdog.ThroughputStall("serve.completed", window_s=4.0)
+    for i in range(11):                     # never advanced at all
+        s.append(0.0, t=float(i))
+        assert not rule.evaluate(float(i))[0]   # idle != stalled
+
+
+def test_throughput_stall_survives_ring_scrollout():
+    # during a long stall the last advance scrolls out of a small
+    # ring; the stateful rule must KEEP firing (judging from the
+    # ring's retained span would falsely clear mid-incident, and at
+    # fast cadences could never fire at all)
+    s = timeseries.series("perf.dispatches", window=4)
+    rule = watchdog.ThroughputStall("perf.dispatches", window_s=2.0)
+    for i in range(3):                      # advances at t=1, t=2
+        s.append(float(i), t=float(i))
+        rule.evaluate(float(i))
+    firing = False
+    for i in range(3, 20):                  # flat ever after
+        s.append(2.0, t=float(i))
+        firing, fields = rule.evaluate(float(i))
+    assert firing                           # still firing at t=19
+    assert fields["stalled_s"] == pytest.approx(17.0)
+
+
+def test_throughput_stall_pending_gate_idle_vs_wedged():
+    # an idle serving host (pending == 0) must never read as a stall;
+    # the same quiet WITH work outstanding must still fire
+    s = timeseries.series("serve.completed")
+    p = timeseries.series("serve.pending")
+    rule = watchdog.ThroughputStall("serve.completed", window_s=4.0,
+                                    pending_metric="serve.pending")
+    s.append(1.0, t=0.0), p.append(0.0, t=0.0)
+    rule.evaluate(0.0)
+    s.append(5.0, t=1.0), p.append(0.0, t=1.0)
+    rule.evaluate(1.0)                      # advanced at t=1
+    for t in (10.0, 60.0, 300.0):           # hours of no offered load
+        assert not rule.evaluate(t)[0]      # idle != stalled
+    p.append(3.0, t=301.0)                  # work arrives and wedges
+    assert not rule.evaluate(301.0)[0]      # clock held at t=300, not 1
+    firing, fields = rule.evaluate(306.0)
+    assert firing and fields["stalled_s"] == pytest.approx(6.0)
+    # the default serving rules carry the gate
+    stalls = [r for r in watchdog.default_rules()
+              if isinstance(r, watchdog.ThroughputStall)]
+    assert stalls and all(r.pending_metric == "serve.pending"
+                          for r in stalls)
+
+
+def test_interval_means_survive_torn_count_total_read():
+    # the sampler appends .count then .total under separate ring locks;
+    # a check() landing between the two appends must not mispair
+    # intervals and fabricate a regression
+    sc = timeseries.series("m.count")
+    st = timeseries.series("m.total")
+    for i in range(6):
+        sc.append(10.0 * (i + 1), t=float(i))
+        st.append(0.1 * (i + 1), t=float(i))
+    sc.append(70.0, t=6.0)                  # torn: newest total missing
+    t, means = watchdog._interval_means(sc, st)
+    assert len(t) == 5 and np.allclose(means, 0.01)
+    rule = watchdog.StepTimeRegression(metric="m", recent_s=2.0,
+                                       min_abs_s=0.0)
+    assert not rule.evaluate(6.0)[0]        # steady run stays quiet
+
+
+def test_step_time_regression_reset_forgets_old_baseline():
+    # the rings outlive a workload: after quiesce, workload B's
+    # compile-heavy warm-up must not be judged against workload A's
+    # millisecond baseline
+    sc = timeseries.series("perf.phase.step.count")
+    st = timeseries.series("perf.phase.step.total")
+    rule = watchdog.StepTimeRegression(recent_s=3.0, min_baseline=3)
+    for i in range(8):                      # workload A: 10 ms steps
+        sc.append(10.0 * (i + 1), t=1000.0 + i)
+        st.append(0.1 * (i + 1), t=1000.0 + i)
+    rule.reset(now=1008.5)                  # train end -> quiesce
+    # workload B's first interval carries a 5 s compile
+    sc.append(82.0, t=1010.0), st.append(5.8, t=1010.0)
+    sc.append(84.0, t=1011.0), st.append(10.8, t=1011.0)
+    assert not rule.evaluate(1011.0)[0]     # warm-up, not a regression
+    for i in range(6):                      # B settles at 20 ms steps
+        sc.append(94.0 + 10.0 * i, t=1012.0 + i)
+        st.append(11.0 + 0.2 * (i + 1), t=1012.0 + i)
+    assert not rule.evaluate(1017.0)[0]     # steady B stays quiet
+    # a REAL post-reset regression still fires against B's baseline
+    sc.append(164.0, t=1019.0), st.append(17.2, t=1019.0)
+    firing, fields = rule.evaluate(1019.0)
+    assert firing and fields["phase"] == "step", fields
+
+
+def test_queue_depth_growth_rule():
+    s = timeseries.series("serve.pending")
+    rule = watchdog.QueueDepthGrowth("serve.pending", samples=5,
+                                     min_depth=16)
+    for t, v in enumerate((2.0, 10.0, 12.0, 14.0, 16.0, 20.0)):
+        s.append(v, t=float(t))
+    firing, fields = rule.evaluate(5.0)
+    assert firing and fields["depth"] == 20.0
+    # shrinking mid-window -> quiet
+    s.append(18.0, t=6.0)
+    assert not rule.evaluate(6.0)[0]
+    # monotonic but shallow stays quiet
+    timeseries.reset()
+    s = timeseries.series("serve.pending")
+    for t, v in enumerate((1.0, 2.0, 3.0, 4.0, 5.0)):
+        s.append(v, t=float(t))
+    assert not rule.evaluate(4.0)[0]
+
+
+def test_heartbeat_quiet_without_coord_env():
+    assert watchdog.HeartbeatQuiet().evaluate(0.0) == (False, {})
+
+
+# --------------------------------------- watchdog fire/clear hysteresis
+class _FlipRule(watchdog.Rule):
+    name = "flip"
+
+    def __init__(self):
+        self.firing = False
+
+    def evaluate(self, now):
+        return self.firing, {"metric": "m"}
+
+
+def test_watchdog_fire_and_clear_no_flapping(obs_dir):
+    rule = _FlipRule()
+    sink_calls = []
+    wd = watchdog.Watchdog(rules=[rule], alert_sink=sink_calls.append,
+                           clear_checks=2)
+    assert wd.check(now=0.0) == []          # quiet start: nothing
+    rule.firing = True
+    fired = wd.check(now=1.0)
+    assert len(fired) == 1 and fired[0]["rule"] == "flip"
+    assert wd.check(now=2.0) == []          # still firing: ONE alert
+    assert wd.firing() == ["flip"]
+    # one quiet tick is NOT a clear (hysteresis)...
+    rule.firing = False
+    wd.check(now=3.0)
+    assert wd.firing() == ["flip"]
+    # ...and flapping back re-arms WITHOUT a second alert
+    rule.firing = True
+    assert wd.check(now=4.0) == []
+    # two consecutive quiet ticks clear it
+    rule.firing = False
+    wd.check(now=5.0)
+    wd.check(now=6.0)
+    assert wd.firing() == []
+    # a genuine second incident alerts again
+    rule.firing = True
+    assert len(wd.check(now=7.0)) == 1
+    assert len(wd.alerts) == 2 and len(sink_calls) == 2
+    # the event log carries typed alert/clear records + instruments
+    kinds = [e["kind"] for e in report.read_events(obs_dir)]
+    assert kinds.count("watchdog_alert") == 2
+    assert kinds.count("watchdog_clear") == 1
+    assert metrics.snapshot()["counters"]["watchdog.alerts"] == 2
+    assert metrics.snapshot()["gauges"]["watchdog.firing.flip"] == 1
+
+
+def test_watchdog_broken_rule_warns_once_never_throws(capsys):
+    class Broken(watchdog.Rule):
+        name = "broken"
+
+        def evaluate(self, now):
+            raise RuntimeError("boom")
+
+    wd = watchdog.Watchdog(rules=[Broken()])
+    assert wd.check(now=0.0) == []
+    assert wd.check(now=1.0) == []
+    assert capsys.readouterr().err.count("WARNING") == 1
+
+
+def test_watchdog_alert_routes_supervisor_seam_and_sink_errors():
+    seam = []
+    supervisor.add_alert_sink(seam.append)
+
+    def bad_sink(alert):
+        raise RuntimeError("sink died")
+
+    rule = _FlipRule()
+    rule.firing = True
+    wd = watchdog.Watchdog(rules=[rule], alert_sink=bad_sink)
+    fired = wd.check(now=1.0)               # bad sink must not throw
+    assert len(fired) == 1
+    assert len(seam) == 1 and seam[0]["kind"] == "watchdog_alert"
+    assert seam[0]["rule"] == "flip"
+
+
+# ----------------------------------------------------------- prometheus
+GOLDEN_SNAPSHOT = {
+    "counters": {"serve.completed": 3},
+    "gauges": {"serve.pending": 2.5, "label": "text-skipped"},
+    "histograms": {"perf.phase.step": {
+        "count": 4, "total": 2.0, "max": 1.0,
+        "p50": 0.5, "p95": 0.9, "p99": 0.95}},
+}
+
+GOLDEN_TEXT = """\
+# TYPE dk_serve_completed_total counter
+dk_serve_completed_total{rank="7"} 3
+# TYPE dk_serve_pending gauge
+dk_serve_pending{rank="7"} 2.5
+# TYPE dk_perf_phase_step summary
+dk_perf_phase_step{quantile="0.5",rank="7"} 0.5
+dk_perf_phase_step{quantile="0.95",rank="7"} 0.9
+dk_perf_phase_step{quantile="0.99",rank="7"} 0.95
+dk_perf_phase_step_sum{rank="7"} 2
+dk_perf_phase_step_count{rank="7"} 4
+"""
+
+
+def test_prometheus_golden_format():
+    assert prometheus.render(snapshot=GOLDEN_SNAPSHOT,
+                             rank=7) == GOLDEN_TEXT
+
+
+def test_prometheus_metric_name_sanitization():
+    assert prometheus.metric_name("a.b-c d") == "dk_a_b_c_d"
+    assert prometheus.metric_name("9lives") == "dk__9lives"
+    assert prometheus.metric_name("ok_name:x") == "dk_ok_name:x"
+
+
+def test_prometheus_label_escaping():
+    text = prometheus.render(
+        snapshot={"counters": {"c": 1}, "gauges": {}, "histograms": {}},
+        labels={"path": 'a"b\\c'}, rank=0)
+    assert 'path="a\\"b\\\\c"' in text
+
+
+def test_to_prometheus_reads_live_registry():
+    metrics.counter("perf.dispatches").inc(9)
+    text = metrics.to_prometheus(rank=3)
+    assert 'dk_perf_dispatches_total{rank="3"} 9' in text
+
+
+def test_exporter_serves_exposition_and_health():
+    metrics.counter("exported").inc(2)
+    exp = prometheus.Exporter(port=0, host="127.0.0.1")
+    host, port = exp.start()
+    try:
+        req = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10)
+        assert req.headers["Content-Type"] == prometheus.CONTENT_TYPE
+        text = req.read().decode()
+        assert 'dk_exported_total{rank="0"} 2' in text
+        # /metricsz alias serves the identical rendering
+        alias = urllib.request.urlopen(
+            f"http://{host}:{port}/metricsz?format=prometheus",
+            timeout=10).read().decode()
+        assert alias == text
+        health = urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10)
+        assert json.loads(health.read())["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/nope",
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        exp.close()
+
+
+def test_maybe_start_exporter_env_gated(monkeypatch):
+    assert prometheus.maybe_start_exporter() is None      # unset = off
+    monkeypatch.setenv("DK_METRICS_PORT", "0")
+    assert prometheus.maybe_start_exporter() is None      # 0 = off
+    monkeypatch.setenv("DK_METRICS_PORT", "bogus")
+    assert prometheus.maybe_start_exporter() is None      # warns, None
+
+
+# ----------------------------------------------------- perf attribution
+def test_perf_install_idempotent():
+    assert perf.install() is True           # jax.monitoring available
+    assert perf.install() is True
+    assert perf.installed()
+
+
+def test_perf_counters_and_phase_histograms():
+    perf.count_dispatch()
+    perf.count_dispatch(3)
+    perf.h2d(1024, 0.001)
+    perf.d2h(2048, 0.002)
+    with perf.phase("step"):
+        pass
+    snap = perf.snapshot()
+    assert snap["dispatches"] == 4
+    assert snap["h2d_bytes"] == 1024 and snap["d2h_bytes"] == 2048
+    step = snap["phases"]["step"]
+    assert step["count"] == 1 and step["mean_s"] is not None
+    # the registry carries the same rows (the sampler's source)
+    c = metrics.snapshot()["counters"]
+    assert c["perf.dispatches"] == 4
+
+
+def test_perf_retrace_listener_counts_compiles():
+    import jax
+
+    perf.install()
+    before = metrics.snapshot()["counters"].get("perf.retraces", 0)
+    f = jax.jit(lambda x: x + 1)
+    f(np.ones(2, np.float32))
+    f(np.ones((2, 2), np.float32))          # new shape = new compile
+    after = metrics.snapshot()["counters"]["perf.retraces"]
+    assert after - before == 2
+
+
+# ------------------------------------------------------------ report
+def test_perf_summary_and_render_attribute_ranks():
+    evs = [
+        {"t": 1.0, "rank": 0, "kind": "metrics",
+         "counters": {"perf.retraces": 2, "perf.dispatches": 10,
+                      "perf.h2d_bytes": 100, "perf.d2h_bytes": 50},
+         "histograms": {"perf.phase.step":
+                        {"count": 10, "total": 1.0}}},
+        # rank 1 never hit an epoch boundary: perf_sample fallback
+        {"t": 2.0, "rank": 1, "kind": "perf_sample", "retraces": 7,
+         "dispatches": 3, "h2d_bytes": 0, "d2h_bytes": 0,
+         "phases": {"step": {"count": 3, "total_s": 0.9,
+                             "mean_s": 0.3}}},
+        {"t": 3.0, "rank": 1, "kind": "watchdog_alert",
+         "rule": "step_time_regression", "phase": "step",
+         "recent_mean_s": 0.3},
+        {"t": 4.0, "rank": 1, "kind": "watchdog_clear",
+         "rule": "step_time_regression"},
+    ]
+    p = report.perf_summary(evs)
+    assert p["per_rank"][0]["retraces"] == 2
+    assert p["per_rank"][0]["phases"]["step"]["mean_s"] == 0.1
+    assert p["per_rank"][1]["retraces"] == 7
+    assert len(p["watchdog_alerts"]) == 1
+    assert p["watchdog_alerts"][0]["rank"] == 1
+    text = report.render_perf("/nonexistent", events=evs)
+    assert "rank 1" in text and "step_time_regression" in text
+    assert "retraces=2" in text and "cleared" in text
+
+
+def test_render_perf_empty_dir_is_actionable(tmp_path):
+    text = report.render_perf(str(tmp_path))
+    assert "no perf telemetry" in text
+
+
+# ------------------------------------------------- supervisor alert seam
+def test_supervisor_giveup_fires_sink_exactly_once():
+    calls = []
+    supervisor.add_alert_sink(calls.append)
+
+    def fn(attempt, resume_step):
+        raise RuntimeError("always down")
+
+    with pytest.raises(CrashLoop):
+        supervise(fn, max_restarts=1, backoff=0.0,
+                  budget_window_s=60.0)
+    giveups = [c for c in calls if c["kind"] == "supervisor_giveup"]
+    assert len(giveups) == 1                # restarts alert NOBODY
+    assert giveups[0]["reason"] == "crash_loop"
+    assert giveups[0]["error"] == "RuntimeError"
+
+
+def test_supervisor_fatal_giveup_alerts_once_too():
+    calls = []
+    supervisor.add_alert_sink(calls.append)
+
+    def fn(attempt, resume_step):
+        raise ValueError("config bug")
+
+    with pytest.raises(ValueError):
+        supervise(fn, max_restarts=3, backoff=0.0,
+                  budget_window_s=60.0)
+    assert len(calls) == 1 and calls[0]["reason"] == "fatal"
+
+
+def test_alert_cmd_webhook_receives_json(tmp_path, monkeypatch):
+    out = tmp_path / "alert.json"
+    monkeypatch.setenv("DK_ALERT_CMD", f"cat > {out}")
+    payload = supervisor.alert("watchdog_alert", rule="flip", rank=1)
+    assert payload["kind"] == "watchdog_alert"
+    deadline = time.time() + 5
+    while not out.exists() and time.time() < deadline:
+        time.sleep(0.01)
+    doc = json.loads(out.read_text())
+    assert doc["kind"] == "watchdog_alert" and doc["rule"] == "flip"
+    assert doc["rank"] == 1                 # caller's rank kept
+
+
+def test_alert_payload_always_names_rank(monkeypatch):
+    # the webhook line is the one delivery an operator sees live: it
+    # must name the firing host even with the event log off
+    assert supervisor.alert("watchdog_alert", rule="r")["rank"] == 0
+    monkeypatch.setenv("DK_COORD_RANK", "5")
+    assert supervisor.alert("watchdog_alert", rule="r")["rank"] == 5
+
+
+def test_alert_never_raises(monkeypatch, capsys):
+    def bad(payload):
+        raise RuntimeError("sink exploded")
+
+    supervisor.add_alert_sink(bad)
+    monkeypatch.setenv("DK_ALERT_CMD", "exit 9")
+    payload = supervisor.alert("ping", x=1)   # must not raise
+    assert payload["x"] == 1
+    supervisor.remove_alert_sink(bad)

@@ -487,6 +487,23 @@ def test_server_predict_health_metrics(served):
     assert "counters" in doc["registry"]
 
 
+def test_server_metricsz_prometheus_exposition(served):
+    from dist_keras_tpu.observability import prometheus
+
+    eng, m, srv, url = served
+    _post(url + "/predict", {"rows": _rows(3).tolist()})
+    with urllib.request.urlopen(url + "/metricsz?format=prometheus",
+                                timeout=60) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == prometheus.CONTENT_TYPE
+        text = r.read().decode()
+    # registry counters + the engine's numeric stats as gauges, one
+    # scrape vocabulary with the standalone exporter
+    assert "# TYPE dk_serve_completed_total counter" in text
+    assert "dk_serve_engine_completed" in text
+    assert "dk_serve_engine_replicas" in text
+
+
 def test_server_error_mapping(served):
     eng, _, srv, url = served
     code, doc = _post(url + "/predict", {"rows": []})
