@@ -1,0 +1,29 @@
+"""dklint — stdlib-only AST invariant checker for this framework.
+
+The runtime guards its invariants dynamically (chaos gate, watchdog,
+typed errors); this package guards the SOURCE invariants that used to
+live in comments and CHANGES.md prose: fault-point/knob/event/metric
+registry consistency (``registries``), signal-handler purity and
+never-throws observability entry points (``purity``), and seam hygiene
+— audited broad excepts, typed-error raises, jit-pure step functions
+(``hygiene``).
+
+Run it as ``python -m dist_keras_tpu.analysis`` (see ``__main__``);
+``gates.py --lint-only`` wraps it into the gate tier and
+``tests/test_dklint.py`` self-checks the real tree on every CI run.
+Programmatic entry: :func:`run_analysis` over any package root —
+fixture trees lint exactly like the real one because registries are
+extracted from the AST, never imported.
+"""
+
+from dist_keras_tpu.analysis.core import (
+    RULES,
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+__all__ = ["RULES", "Finding", "run_analysis", "load_baseline",
+           "write_baseline", "apply_baseline"]

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dist_keras_tpu.utils import knobs
+
 
 _initialized = False
 _barrier_poisoned = None  # message of the timeout that desynced barriers
@@ -62,6 +64,8 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
             num_processes=num_processes, process_id=process_id, **kw)
     except RuntimeError as e:
         if "must be called before" in str(e):
+            # dklint: ignore[untyped-raise] bring-up ordering mistake
+            # rewritten with the actionable fix — fatal by design
             raise RuntimeError(
                 "multi-host bring-up came too late: something already "
                 "initialised the XLA backend (model construction, "
@@ -176,7 +180,7 @@ def barrier(tag="dist_keras_tpu_barrier", timeout_s=None):
             from dist_keras_tpu.resilience import coordination
 
             def probe():
-                d = os.environ.get("DK_COORD_DIR")
+                d = knobs.raw("DK_COORD_DIR")
                 if not d:
                     return []
                 # evidence-only (beat once, went dark): PeerLost must

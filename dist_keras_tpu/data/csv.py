@@ -22,14 +22,33 @@ def _native_lib():
     return load_fastcsv()
 
 
+_native_warned = False
+
+
+def _warn_native_once(e):
+    """One stderr warning per process when the native reader fails and
+    the pandas fallback takes over — same convention as the event log's
+    dropped-write warning."""
+    global _native_warned
+    if _native_warned:
+        return
+    _native_warned = True
+    import sys
+
+    print(f"[dk.data] WARNING: native CSV reader failed ({e!r}) - "
+          "falling back to pandas", file=sys.stderr, flush=True)
+
+
 def read_numeric_csv(path, has_header=True, dtype=np.float32):
     """Parse an all-numeric CSV into (matrix, column_names)."""
     lib = _native_lib()
     if lib is not None:
         try:
             return _read_native(lib, path, has_header, dtype)
-        except Exception:
-            pass  # fall back to pandas below
+        # audit fix: a native-reader bug used to be invisible here
+        # dklint: ignore[broad-except] audible full-fidelity pandas fallback
+        except Exception as e:
+            _warn_native_once(e)  # fall back to pandas below
     import pandas as pd
     df = pd.read_csv(path, header=0 if has_header else None)
     names = [str(c) for c in df.columns]

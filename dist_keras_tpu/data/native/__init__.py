@@ -49,6 +49,7 @@ def build_fastcsv(force=False):
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return so
+    # dklint: ignore[broad-except] toolchain probe: no working g++ means no native lib
     except Exception:
         return None
 

@@ -160,6 +160,7 @@ class SocketSource(StreamSource):
                             break
                         self._inner.put(
                             json.loads(payload.decode("utf-8")))
+        # dklint: ignore[broad-except] listener thread surfaces the error to the consumer via self.error
         except Exception as e:
             if not self._shutdown:  # surface to the consumer, never a
                 self.error = e      # silent clean end-of-stream; but a

@@ -102,13 +102,18 @@ class DenseTransformer(Transformer):
 
     def transform(self, dataset):
         col = dataset[self.input_col]
+        # audit fix (round 12): the old blanket try swallowed REAL
+        # densify errors (a MemoryError from todense) and fell through
+        # to the pair-row path's misleading "needs size=" ValueError —
+        # only the optional-dependency probe may be forgiven
         try:  # scipy sparse matrix stored whole
             import scipy.sparse as sp
-            if sp.issparse(col):
-                return dataset.with_column(
-                    self.output_col, np.asarray(col.todense(), np.float32))
-        except Exception:
-            pass
+            is_sparse = sp.issparse(col)
+        except ImportError:
+            is_sparse = False
+        if is_sparse:
+            return dataset.with_column(
+                self.output_col, np.asarray(col.todense(), np.float32))
         if self.size is None:
             raise ValueError("DenseTransformer needs size= for pair rows")
         out = np.zeros((len(col), self.size), dtype=np.float32)

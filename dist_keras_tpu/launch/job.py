@@ -231,6 +231,7 @@ class Job:
         if point is not None:
             # fault hook: a replace-fault forges the return code, so a
             # flaky transport is simulated without a cluster
+            # dklint: fault-points=job.rsync,job.ssh
             rc = fault_point(point, value=rc)
         return rc
 

@@ -58,18 +58,55 @@ import sys
 import threading
 import time
 
+from dist_keras_tpu.utils import knobs
+
 _lock = threading.Lock()
 _resolved = False      # has the DK_OBS_DIR decision been made?
 _writer = None         # EventWriter when enabled, None when disabled
 _warned = False        # one dropped-event warning per process
+
+# The event vocabulary — every ``kind`` any seam emits (including the
+# repo-root ``bench.py`` driver's).  Adding an emit("...") call site?
+# Register the kind here AND add a row to the README event-schema
+# table, or the ``event-unregistered`` / ``event-undocumented`` lint
+# rules (``python -m dist_keras_tpu.analysis``) fail the tree.  The
+# registry is deliberately a flat tuple: report.py and operator
+# tooling treat it as the closed set of kinds they can attribute.
+KNOWN_EVENTS = (
+    # training lifecycle (trainers/base.py, trainers/chunking.py)
+    "train_start", "train_end", "epoch_end", "chunk", "resume",
+    "metrics",
+    # spans (observability/spans.py)
+    "span_begin", "span_end",
+    # checkpointing (checkpoint.py)
+    "ckpt_save", "ckpt_promote", "ckpt_restore", "ckpt_verify",
+    "ckpt_corrupt",
+    # resilience seams
+    "retry", "retry_exhausted", "fault", "nonfinite", "nan_halt",
+    "preempt_signal", "preempt", "preempt_exit",
+    "coord", "coord_error", "barrier", "peer_dead",
+    "supervisor_restart", "supervisor_giveup",
+    # serving (serving/)
+    "serve_enqueue", "serve_batch_flush", "serve_batch_error",
+    "serve_predict", "serve_predict_error",
+    "serve_reload", "serve_reload_error", "reload_skipped_corrupt",
+    "serve_listen", "serve_drain_begin", "serve_drain_signal",
+    "serve_drain",
+    # telemetry plane (observability/)
+    "perf_sample", "watchdog_alert", "watchdog_clear",
+    "metrics_exporter_listen",
+    # bench driver (repo-root bench.py)
+    "bench_probe_begin", "bench_probe_end", "bench_config_begin",
+    "bench_config_end", "bench_config_skipped", "bench_complete",
+)
 
 
 def _default_rank():
     """This host's rank WITHOUT importing jax (the event log must work
     before — and while — the device backend is wedged): the coordination
     identity wins, then the launcher's jax.distributed id, then 0."""
-    for var in ("DK_COORD_RANK", "JAX_PROCESS_ID"):
-        v = os.environ.get(var)
+    for v in (knobs.raw("DK_COORD_RANK"),
+              os.environ.get("JAX_PROCESS_ID")):
         if v is not None:
             try:
                 return int(v)
@@ -91,22 +128,17 @@ class EventWriter:
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self.rank = _default_rank() if rank is None else int(rank)
         if fsync is None:
-            fsync = os.environ.get("DK_OBS_FLUSH", "") \
-                in ("1", "true", "fsync")
+            # registry bool convention ("fsync" is just another truthy
+            # spelling); unset -> the registered False default
+            fsync = knobs.get("DK_OBS_FLUSH")
         self.fsync = bool(fsync)
         if rotate_bytes is None:
-            try:
-                rotate_bytes = int(float(
-                    os.environ.get("DK_OBS_ROTATE_MB", "0") or 0) * 2**20)
-            except ValueError:
-                rotate_bytes = 0  # malformed knob: log unbounded, not die
+            # registry-parsed: malformed falls back to the registered
+            # default (log unbounded, not die)
+            rotate_bytes = int(knobs.get("DK_OBS_ROTATE_MB") * 2**20)
         self.rotate_bytes = max(0, int(rotate_bytes))  # 0 = never rotate
         if rotate_keep is None:
-            try:
-                rotate_keep = int(
-                    os.environ.get("DK_OBS_ROTATE_KEEP", "3") or 3)
-            except ValueError:
-                rotate_keep = 3
+            rotate_keep = int(knobs.get("DK_OBS_ROTATE_KEEP"))
         self.rotate_keep = max(1, int(rotate_keep))
         self.path = os.path.join(self.directory,
                                  f"events-rank_{self.rank}.jsonl")
@@ -192,10 +224,11 @@ def _resolve():
     with _lock:
         if _resolved:
             return
-        directory = os.environ.get("DK_OBS_DIR")
+        directory = knobs.raw("DK_OBS_DIR")
         if directory:
             try:
                 _writer = EventWriter(directory)
+            # dklint: ignore[broad-except] event-log open failure degrades to disabled + one warning
             except Exception as e:
                 _warn_once(f"could not open event log in "
                            f"{directory!r}: {e!r}")
@@ -250,7 +283,10 @@ def emit(kind, **fields):
     if w is None:
         return
     try:
+        # dklint: ignore[event-dynamic] pure forwarder: the literal
+        # kind is checked at every emit() call site, not here
         w.emit(kind, **fields)
+    # dklint: ignore[broad-except] the never-throws emit contract: dropped event + one warning
     except Exception as e:
         _warn_once(f"event emit failed ({kind}): {e!r}")
 

@@ -149,6 +149,53 @@ class Histogram:
         }
 
 
+# The metric vocabulary — every instrument name any seam registers,
+# with its kind.  Entries containing ``*`` are fnmatch patterns for
+# dynamic families (the call site carries a ``# dklint: metrics=<pat>``
+# annotation naming its pattern).  Adding a counter/gauge/histogram?
+# Register it here AND add a row to the README metrics table, or the
+# ``metric-unregistered`` / ``metric-undocumented`` lint rules fail
+# the tree; exact names must also stay collision-free after Prometheus
+# sanitization (``metric-collision``).
+KNOWN_METRICS = {
+    # training
+    "train.nonfinite_steps": "counter",
+    # streaming data plane
+    "stream.batches": "counter",
+    "stream.rows": "counter",
+    # retry surfaces (resilience/retry.py — per-surface families)
+    "*.retries": "counter",
+    "*.exhausted": "counter",
+    # supervisor
+    "supervisor.restarts": "counter",
+    "supervisor.giveups": "counter",
+    # serving
+    "serve.enqueued": "counter",
+    "serve.completed": "counter",
+    "serve.rejected": "counter",
+    "serve.errors": "counter",
+    "serve.reloads": "counter",
+    "serve.reload.skipped_corrupt": "counter",
+    "serve.reload.errors": "counter",
+    "serve.pending": "gauge",
+    "serve.predict_s": "histogram",
+    # perf attribution (observability/perf.py)
+    "perf.retraces": "counter",
+    "perf.traces": "counter",
+    "perf.dispatches": "counter",
+    "perf.h2d_bytes": "counter",
+    "perf.d2h_bytes": "counter",
+    "perf.compile_s": "histogram",
+    "perf.h2d_s": "histogram",
+    "perf.d2h_s": "histogram",
+    "perf.phase.*": "histogram",
+    # spans (observability/spans.py)
+    "span.*": "histogram",
+    # watchdog
+    "watchdog.alerts": "counter",
+    "watchdog.firing.*": "gauge",
+}
+
 _lock = threading.Lock()
 _registry = {}  # name -> instrument
 

@@ -83,6 +83,7 @@ def install():
 
             jax.monitoring.register_event_duration_secs_listener(
                 _on_duration)
+        # dklint: ignore[broad-except] jax.monitoring is optional; no listener means no retrace counts
         except Exception:
             return False
         _installed = True
@@ -133,6 +134,7 @@ def phase(name, **fields):
         try:
             yield
         finally:
+            # dklint: metrics=perf.phase.*
             metrics.histogram(f"perf.phase.{name}").observe(
                 time.perf_counter() - t0)
 

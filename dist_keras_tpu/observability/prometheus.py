@@ -31,6 +31,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.utils import knobs
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -195,11 +196,10 @@ def maybe_start_exporter():
     every host in a pod scrapes on the same port.  -> the exporter or
     None; a bind failure warns once and stays None (telemetry must not
     kill the run)."""
-    import os
     import sys
 
     global _exporter
-    raw = os.environ.get("DK_METRICS_PORT", "").strip()
+    raw = (knobs.raw("DK_METRICS_PORT") or "").strip()
     if not raw:
         return None
     with _lock:
@@ -211,6 +211,7 @@ def maybe_start_exporter():
                 return None
             exp = Exporter(port=port)
             exp.start()
+        # dklint: ignore[broad-except] exporter bind failure warns once; telemetry must not kill the run
         except Exception as e:
             print(f"[dk.observability] WARNING: metrics exporter on "
                   f"port {raw!r} failed: {e!r}", file=sys.stderr,

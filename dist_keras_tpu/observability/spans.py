@@ -69,6 +69,7 @@ def _span_impl(name, fields):
 
             ann = jax.profiler.TraceAnnotation(path)
             ann.__enter__()
+        # dklint: ignore[broad-except] the device trace must not break host spans
         except Exception:  # the device trace must not break host spans
             ann = None
     events.emit("span_begin", span=path, **fields)
@@ -80,10 +81,12 @@ def _span_impl(name, fields):
         if ann is not None:
             try:
                 ann.__exit__(None, None, None)
+            # dklint: ignore[broad-except] profiler teardown is best-effort
             except Exception:  # pragma: no cover - profiler teardown
                 pass
         events.emit("span_end", span=path, duration_s=dt, **fields)
         if events.enabled():
+            # dklint: metrics=span.*
             metrics.histogram(f"span.{path}").observe(dt)
         st.pop()
 

@@ -43,23 +43,22 @@ cadence, so a scrape-port-only config still serves — see
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
 import numpy as np
 
 from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.utils import knobs
 
-DEFAULT_WINDOW = 512
+# the registry's default is the single source of truth
+DEFAULT_WINDOW = knobs.KNOBS["DK_OBS_TS_WINDOW"].default
 
 
 def _default_window():
-    try:
-        w = int(os.environ.get("DK_OBS_TS_WINDOW", "") or DEFAULT_WINDOW)
-    except ValueError:
-        w = DEFAULT_WINDOW
-    return max(2, w)
+    # registry-parsed (default 512, malformed -> default); the floor
+    # keeps a degenerate ring usable
+    return max(2, int(knobs.get("DK_OBS_TS_WINDOW")))
 
 
 class TimeSeries:
@@ -181,7 +180,7 @@ def default_sample_s():
     """The ``DK_OBS_SAMPLE_S`` cadence, or None when unset/malformed
     (malformed = sampler stays off, loudly on stderr would be noise —
     the README documents the knob as float seconds)."""
-    raw = os.environ.get("DK_OBS_SAMPLE_S", "").strip()
+    raw = (knobs.raw("DK_OBS_SAMPLE_S") or "").strip()
     if not raw:
         return None
     try:
@@ -226,11 +225,13 @@ class MetricsSampler:
             # means from deltas)
             snap = metrics.snapshot(percentiles=False)
             record_snapshot(snap, t=now)
+        # dklint: ignore[broad-except] a registry snapshot failure must not kill the sampler tick
         except Exception:  # pragma: no cover - registry must not kill
             pass
         if self.watchdog is not None:
             try:
                 self.watchdog.check(now=now)
+            # dklint: ignore[broad-except] watchdog.check never throws; belt-and-braces for the tick
             except Exception:  # pragma: no cover - never throws anyway
                 pass
         if events.enabled():
@@ -238,6 +239,7 @@ class MetricsSampler:
                 from dist_keras_tpu.observability import perf
 
                 events.emit("perf_sample", **perf.snapshot(snap=snap))
+            # dklint: ignore[broad-except] a failed perf_sample is a dropped sample, not a dead sampler
             except Exception:  # pragma: no cover - dropped sample
                 pass
         self.ticks += 1
@@ -298,6 +300,7 @@ def maybe_start_sampler():
         from dist_keras_tpu.observability import prometheus
 
         prometheus.maybe_start_exporter()
+    # dklint: ignore[broad-except] exporter bring-up is best-effort; telemetry must not kill
     except Exception:  # pragma: no cover - exporter must not kill
         pass
     interval = default_sample_s()
@@ -307,7 +310,7 @@ def maybe_start_sampler():
         sampler = _global["sampler"]
         if sampler is None:
             wd = None
-            if os.environ.get("DK_WATCHDOG", "") not in ("0", "off"):
+            if knobs.get("DK_WATCHDOG"):
                 from dist_keras_tpu.observability import watchdog
 
                 wd = watchdog.Watchdog()

@@ -44,7 +44,6 @@ to "not firing" plus one stderr warning per process.
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
@@ -52,6 +51,7 @@ import time
 import numpy as np
 
 from dist_keras_tpu.observability import events, metrics, timeseries
+from dist_keras_tpu.utils import knobs
 
 
 class Rule:
@@ -270,11 +270,11 @@ class HeartbeatQuiet(Rule):
     name = "heartbeat_quiet"
 
     def evaluate(self, now):
-        d = os.environ.get("DK_COORD_DIR")
+        d = knobs.raw("DK_COORD_DIR")
         if not d:
             return False, {}
         try:
-            world = int(os.environ.get("DK_COORD_WORLD", "0") or 0)
+            world = int(knobs.raw("DK_COORD_WORLD") or 0)
         except ValueError:
             return False, {}
         if world < 2:
@@ -339,6 +339,7 @@ class Watchdog:
         for rule in self.rules:
             try:
                 rule.reset()
+            # dklint: ignore[broad-except] a broken rule reset degrades to a one-time warning
             except Exception as e:
                 self._warn_once(rule, e)
 
@@ -358,11 +359,13 @@ class Watchdog:
             from dist_keras_tpu.resilience import supervisor
 
             supervisor.alert("watchdog_alert", **alert)
+        # dklint: ignore[broad-except] the alert seam never raises into the sampler thread
         except Exception:  # pragma: no cover - alert seam never raises
             pass
         if self.alert_sink is not None:
             try:
                 self.alert_sink(alert)
+            # dklint: ignore[broad-except] a broken alert_sink warns; alerting must not kill the run
             except Exception as e:
                 print(f"[dk.watchdog] WARNING: alert_sink raised {e!r}",
                       file=sys.stderr, flush=True)
@@ -375,6 +378,7 @@ class Watchdog:
         for rule in self.rules:
             try:
                 firing, fields = rule.evaluate(now)
+            # dklint: ignore[broad-except] a broken rule degrades to not-firing + one warning
             except Exception as e:
                 self._warn_once(rule, e)
                 firing, fields = False, {}
@@ -393,6 +397,7 @@ class Watchdog:
                             st["firing"] = False
                             st["clears"] = 0
                             events.emit("watchdog_clear", rule=rule.name)
+                            # dklint: metrics=watchdog.firing.*
                             metrics.gauge(
                                 f"watchdog.firing.{rule.name}").set(0)
             if transition:
@@ -401,6 +406,7 @@ class Watchdog:
                 fired.append(alert)
                 events.emit("watchdog_alert", **alert)
                 metrics.counter("watchdog.alerts").inc()
+                # dklint: metrics=watchdog.firing.*
                 metrics.gauge(f"watchdog.firing.{rule.name}").set(1)
                 self._deliver(alert)
         return fired

@@ -53,6 +53,7 @@ try:  # TPU-specific pallas helpers (absent in CPU-only builds)
     from jax.experimental.pallas import tpu as pltpu
 
     _VMEM = pltpu.VMEM
+# dklint: ignore[broad-except] optional-backend import probe (CPU-only jax builds)
 except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None

@@ -61,6 +61,7 @@ from jax.experimental import pallas as pl
 
 try:
     from jax.experimental.pallas import tpu as pltpu
+# dklint: ignore[broad-except] optional-backend import probe (CPU-only jax builds)
 except Exception:  # pragma: no cover - CPU-only jax builds
     pltpu = None
 

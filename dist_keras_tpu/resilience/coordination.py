@@ -53,6 +53,7 @@ import threading
 import time
 
 from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.utils import knobs
 
 
 def default_timeout_s():
@@ -62,10 +63,7 @@ def default_timeout_s():
     checkpoint commit wait, and ``comm.barrier``'s default.  A
     malformed value falls back to 120 rather than crashing a worker
     mid-run."""
-    try:
-        return float(os.environ.get("DK_COORD_TIMEOUT_S", "120"))
-    except ValueError:
-        return 120.0
+    return float(knobs.get("DK_COORD_TIMEOUT_S"))
 
 
 # import-time snapshot kept for back-compat readers; new code should
@@ -115,7 +113,9 @@ def with_deadline(fn, timeout_s, what, stale_probe=None):
     def run():
         try:
             box["value"] = fn()
-        except BaseException as e:  # re-raised on the caller thread
+        # dklint: ignore[broad-except] not a swallow: captured and
+        # RE-RAISED on the caller thread (with_deadline's contract)
+        except BaseException as e:
             box["error"] = e
 
     t = threading.Thread(target=run, daemon=True,
@@ -206,6 +206,7 @@ class Heartbeat:
                 # the injected death: this host goes dark for good;
                 # peers' next probe names it via dead_peers
                 return
+            # dklint: ignore[broad-except] a transient liveness-file error must not silence a healthy host
             except Exception:
                 # a TRANSIENT liveness-file error (NFS blip, EDQUOT)
                 # must not silence a healthy host permanently — one
@@ -415,7 +416,7 @@ class JaxCoordinator(Coordinator):
         return vals
 
     def stale_peers(self):
-        d = os.environ.get("DK_COORD_DIR")
+        d = knobs.raw("DK_COORD_DIR")
         if not d:
             return []
         return self._note_dead(dead_peers(_session_root(d), self.world,
@@ -428,7 +429,7 @@ def _coord_env(var):
     would seat two leaders, world defaulting to 1 would silently turn
     the two-phase commit OFF on the very directory the operator
     configured for it."""
-    value = os.environ.get(var)
+    value = knobs.raw(var)
     if value is None:
         raise ValueError(
             f"DK_COORD_DIR is set but {var} is not: the coordination "
@@ -446,7 +447,7 @@ def _session_root(directory):
     the same path (``launch.Job`` explicitly admits ``~`` in
     coord_dir)."""
     directory = os.path.expanduser(directory)
-    session = os.environ.get("DK_COORD_SESSION", "")
+    session = knobs.raw("DK_COORD_SESSION") or ""
     return os.path.join(directory, session) if session else directory
 
 
@@ -483,9 +484,9 @@ class FileCoordinator(Coordinator):
         # PeerLost aborts a healthy run; tune DK_COORD_STALE_S down for
         # local-disk test rigs that want fast dead-peer verdicts
         if stale_after_s is None:
-            stale_after_s = float(os.environ.get(
-                "DK_COORD_STALE_S", max(10 * heartbeat_interval_s,
-                                        10.0)))
+            stale_after_s = float(
+                knobs.raw("DK_COORD_STALE_S")
+                or max(10 * heartbeat_interval_s, 10.0))
         self.stale_after_s = float(stale_after_s)
         self._ops = os.path.join(self.directory, "ops")
         os.makedirs(self._ops, exist_ok=True)
@@ -573,7 +574,7 @@ def get_coordinator():
     global _coordinator
     with _lock:
         if _coordinator is None:
-            d = os.environ.get("DK_COORD_DIR")
+            d = knobs.raw("DK_COORD_DIR")
             if d:
                 _coordinator = FileCoordinator(d)
             else:
@@ -600,7 +601,7 @@ def rank():
     unless a group is already the selection criterion.  With
     ``DK_COORD_DIR`` set, the companion vars are REQUIRED (same rule as
     ``FileCoordinator``) — no silent identity defaults."""
-    if os.environ.get("DK_COORD_DIR"):
+    if knobs.raw("DK_COORD_DIR"):
         return int(_coord_env("DK_COORD_RANK"))
     import jax
 
@@ -608,7 +609,7 @@ def rank():
 
 
 def world():
-    if os.environ.get("DK_COORD_DIR"):
+    if knobs.raw("DK_COORD_DIR"):
         return int(_coord_env("DK_COORD_WORLD"))
     import jax
 
@@ -631,7 +632,7 @@ def dead_peers_at(coord_dir, world, stale_after_s=None,
     incarnation's heartbeats, not its own (session-less) environment's
     view of the old ones."""
     if stale_after_s is None:
-        stale_after_s = float(os.environ.get("DK_COORD_STALE_S", "10"))
+        stale_after_s = float(knobs.raw("DK_COORD_STALE_S") or "10")
     if session is None:
         root = _session_root(str(coord_dir))
     else:

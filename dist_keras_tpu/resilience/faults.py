@@ -73,6 +73,8 @@ import os
 import re
 import threading
 
+from dist_keras_tpu.utils import knobs
+
 
 class FaultInjected(Exception):
     """Raised by an armed fault point.
@@ -102,8 +104,11 @@ _env_loaded = False
 
 # Every named fault point in the framework — the registry chaos mode
 # arms.  Adding a fault_point call site?  List it here or the chaos
-# gate can never exercise it.  (Grouped by seam; names are the ones
-# passed to fault_point at each call site.)
+# gate can never exercise it — and since round 12 the static analyzer
+# enforces BOTH directions (`python -m dist_keras_tpu.analysis`:
+# fault-point-unknown / fault-point-unused; dynamic-name sites carry a
+# `# dklint: fault-points=...` annotation).  (Grouped by seam; names
+# are the ones passed to fault_point at each call site.)
 KNOWN_POINTS = (
     "checkpoint.save", "checkpoint.commit", "coord.commit",
     "coord.flag", "coord.agree", "coord.barrier",
@@ -284,7 +289,7 @@ def _load_chaos_env():
     """Arm the ``DK_FAULTS_SEED`` chaos schedule (under _lock, from
     load_env).  Malformed knobs fail LOUDLY at load time, like
     DK_FAULTS entries."""
-    seed = os.environ.get("DK_FAULTS_SEED", "").strip()
+    seed = (knobs.raw("DK_FAULTS_SEED") or "").strip()
     if not seed:
         return
     try:
@@ -292,20 +297,20 @@ def _load_chaos_env():
     except ValueError:
         raise ValueError(
             f"malformed DK_FAULTS_SEED {seed!r}: expected an integer")
-    rate = os.environ.get("DK_FAULTS_RATE", "0.25").strip() or "0.25"
+    rate = (knobs.raw("DK_FAULTS_RATE") or "0.25").strip() or "0.25"
     try:
         rate = float(rate)
     except ValueError:
         raise ValueError(
             f"malformed DK_FAULTS_RATE {rate!r}: expected a float")
-    horizon = os.environ.get("DK_FAULTS_HORIZON", "20").strip() or "20"
+    horizon = (knobs.raw("DK_FAULTS_HORIZON") or "20").strip() or "20"
     try:
         horizon = int(horizon)
     except ValueError:
         raise ValueError(
             f"malformed DK_FAULTS_HORIZON {horizon!r}: expected an int")
     points = None
-    raw_points = os.environ.get("DK_FAULTS_POINTS", "").strip()
+    raw_points = (knobs.raw("DK_FAULTS_POINTS") or "").strip()
     if raw_points:
         points = tuple(p.strip() for p in raw_points.split(",")
                        if p.strip())
@@ -329,7 +334,12 @@ def load_env(var="DK_FAULTS", force=False):
         if _env_loaded and not force:
             return
         _env_loaded = True
-        for entry in os.environ.get(var, "").split(";"):
+        # the default var resolves through the knob registry; a
+        # caller-supplied custom variable name stays a plain env read
+        # (knobs.raw would refuse an unregistered name)
+        raw = (knobs.raw(var) if var in knobs.KNOBS
+               else os.environ.get(var)) or ""
+        for entry in raw.split(";"):
             spec = _parse_env_entry(entry)
             if spec is not None:
                 _specs.setdefault(spec.point, []).append(spec)

@@ -122,6 +122,8 @@ def install(signals=(signal.SIGTERM, signal.SIGINT), strict=True):
     :func:`clear` first."""
     if threading.current_thread() is not threading.main_thread():
         if strict:
+            # dklint: ignore[untyped-raise] actionable usage error at
+            # install time, before any training state exists
             raise RuntimeError(
                 "preemption.install() must run on the MAIN thread: "
                 "Python only allows signal handlers there "

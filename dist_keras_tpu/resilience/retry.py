@@ -125,6 +125,7 @@ class RetryPolicy:
                     if remaining <= 0:
                         break  # out of time: don't start another attempt
                     d = min(d, remaining)
+                # dklint: metrics=*.retries
                 metrics.counter(f"{surface}.retries").inc()
                 events.emit("retry", name=surface, attempt=attempt,
                             error=type(e).__name__, delay_s=d)
@@ -132,6 +133,7 @@ class RetryPolicy:
                     self.on_retry(attempt, e, d)
                 if d > 0:
                     self.sleep(d)
+        # dklint: metrics=*.exhausted
         metrics.counter(f"{surface}.exhausted").inc()
         events.emit("retry_exhausted", name=surface, attempts=attempt,
                     error=type(last).__name__)

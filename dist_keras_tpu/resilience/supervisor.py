@@ -54,6 +54,7 @@ from collections import deque
 
 from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.resilience.retry import RetryPolicy
+from dist_keras_tpu.utils import knobs
 
 # ---------------------------------------------------------------------
 # Operator alerting seam.  Emitting a supervisor_giveup EVENT records
@@ -115,27 +116,26 @@ def alert(kind, **fields):
             # identity must reach the webhook regardless
             r = events.rank()
             payload["rank"] = events._default_rank() if r is None else r
+        # dklint: ignore[broad-except] best-effort rank attribution for the webhook payload
         except Exception:  # pragma: no cover - attribution best-effort
             pass
     for sink in list(_alert_sinks):
         try:
             sink(payload)
+        # dklint: ignore[broad-except] alert sinks are best-effort; a broken sink never kills the run
         except Exception as e:
             _alert_warn_once(("sink", sink), f"alert sink {sink!r} "
                                              f"raised {e!r}")
-    cmd = os.environ.get("DK_ALERT_CMD")
+    cmd = knobs.raw("DK_ALERT_CMD")
     if cmd:
-        try:
-            timeout = float(os.environ.get("DK_ALERT_CMD_TIMEOUT_S",
-                                           "10") or 10)
-        except ValueError:
-            timeout = 10.0
+        timeout = knobs.get("DK_ALERT_CMD_TIMEOUT_S")
         try:
             subprocess.run(
                 cmd, shell=True,
                 input=(json.dumps(payload, default=str) + "\n").encode(),
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 timeout=timeout)
+        # dklint: ignore[broad-except] DK_ALERT_CMD webhook delivery is best-effort
         except Exception as e:
             _alert_warn_once(("cmd", cmd),
                              f"DK_ALERT_CMD failed: {e!r}")
@@ -249,6 +249,9 @@ def supervise(fn, checkpointer=None, *, max_restarts=3,
             alert("supervisor_giveup", reason="fatal", attempt=attempt,
                   error=type(e).__name__, detail=str(e)[:200])
             raise
+        # dklint: ignore[broad-except] the supervisor's whole job:
+        # classify ANY non-fatal failure into the restart budget
+        # (fatal types re-raised by the handler above)
         except (Exception, Preempted) as e:
             if isinstance(e, Preempted):
                 # the per-process flag survives the exception; left

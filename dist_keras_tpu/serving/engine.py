@@ -333,6 +333,7 @@ class ServingEngine:
             try:
                 rung = self._rung_for(len(take))
                 x, n = pack_rows([r.x for r in take], rung)
+            # dklint: ignore[broad-except] a ragged batch fails ITS OWN futures typed, never the batcher thread
             except Exception as e:
                 # a malformed row (ragged shapes across one batch) must
                 # fail ITS OWN requests typed — not kill the batcher
@@ -375,6 +376,7 @@ class ServingEngine:
                 if rep.device is not None:
                     xb = jax.device_put(xb, rep.device)
                 preds = np.asarray(self._apply(rep.params, xb))
+            # dklint: ignore[broad-except] the predict error lands TYPED on every future in the batch
             except Exception as e:
                 # typed error to every waiter in the batch — a failed
                 # predict must never hang a caller

@@ -175,6 +175,7 @@ class CheckpointWatcher:
         while not self._stop.is_set():
             try:
                 self.poll_once()
+            # dklint: ignore[broad-except] reload failure is typed + non-fatal; old params keep serving
             except Exception as e:
                 # typed, recorded, non-fatal: keep serving old params
                 self.errors += 1
@@ -184,6 +185,7 @@ class CheckpointWatcher:
                 if self.on_error is not None:
                     try:
                         self.on_error(self.checkpointer.latest_step(), e)
+                    # dklint: ignore[broad-except] user on_reload hook is best-effort
                     except Exception:  # pragma: no cover - user hook
                         pass
             self._stop.wait(self.poll_s)

@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -46,6 +45,7 @@ from dist_keras_tpu.observability import events
 from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.resilience import preemption
 from dist_keras_tpu.serving.engine import Overloaded
+from dist_keras_tpu.utils import knobs
 
 
 def default_port(fallback=8000):
@@ -53,7 +53,7 @@ def default_port(fallback=8000):
     (exported per host by ``launch.Job(serve_port=...)``), else
     ``fallback``."""
     try:
-        return int(os.environ.get("DK_SERVE_PORT", "") or fallback)
+        return int(knobs.raw("DK_SERVE_PORT") or fallback)
     except ValueError:
         return fallback
 
@@ -143,6 +143,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "bad_request",
                               "detail": str(e)[:200]})
             return
+        # dklint: ignore[broad-except] admission error maps to a typed HTTP status, never a dead handler
         except Exception as e:  # typed admission error (enqueue fault)
             self._reply(500, {"error": type(e).__name__,
                               "detail": str(e)[:200]})
@@ -157,6 +158,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(504, {"error": "timeout",
                               "timeout_s": srv.request_timeout_s})
             return
+        # dklint: ignore[broad-except] predict error maps to a typed HTTP 500 naming the type
         except Exception as e:  # typed predict error (fault, OOM, ...)
             self._reply(500, {"error": type(e).__name__,
                               "detail": str(e)[:200]})

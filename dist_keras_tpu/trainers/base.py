@@ -139,6 +139,7 @@ class Trainer:
                 from dist_keras_tpu.observability import report
 
                 report.write_report(events.obs_dir())
+            # dklint: ignore[broad-except] best-effort report write on the way out of training
             except Exception:  # pragma: no cover - fs failure
                 pass
 
@@ -231,6 +232,7 @@ class Trainer:
         if fn is None:
             try:
                 fn = builder()
+            # dklint: ignore[broad-except] a failed jit build must drop its key pins, then re-raise
             except Exception:
                 # _cache_key's _tok pinned the key's objects into
                 # _id_pins before the lookup; a failed build never gets
@@ -312,6 +314,7 @@ class Trainer:
             # is its typed verdict — laundering either into ValueError
             # would turn a retryable restart into a permanent giveup
             raise
+        # dklint: ignore[broad-except] re-raised (with an actionable incompatible-checkpoint hint)
         except Exception as e:
             if incompatible_hint:
                 raise ValueError(

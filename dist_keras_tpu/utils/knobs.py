@@ -1,0 +1,216 @@
+"""Central registry of every ``DK_*`` environment knob — name, default,
+parser, one-line doc.
+
+Before this module the framework's ~25 operator knobs were defined by
+their read sites: a knob existed wherever some module happened to call
+``os.environ.get("DK_...")``, its default lived in that call, and the
+README tables were synced by hand.  Now every knob is REGISTERED here
+once, every read site resolves through :func:`raw` / :func:`get`, and
+the static analyzer (``python -m dist_keras_tpu.analysis``) enforces
+both directions:
+
+- a ``DK_*`` read that bypasses this registry anywhere under
+  ``dist_keras_tpu/`` is a ``knob-read`` lint finding;
+- a registered knob missing from the README knob tables (or a ``DK_*``
+  name documented there but never registered) is a ``knob-undocumented``
+  / ``knob-doc-drift`` finding.  :func:`doc_table` renders the
+  registry as the markdown table the README carries.
+
+Semantics are deliberately thin: :func:`raw` is exactly
+``os.environ.get(name)`` (per-call re-read, so launcher-exported values
+win regardless of import order — the round-7 contract), plus a loud
+``KeyError`` for unregistered names.  :func:`get` adds the registered
+default and parser; ``on_error`` chooses between the knob's documented
+malformed-value behaviour: ``"default"`` (telemetry knobs degrade
+silently) or ``"raise"`` (schedule knobs like ``DK_FAULTS_RATE`` fail
+loudly at load time).  Call sites that need richer handling — dynamic
+defaults, companion-var validation — use :func:`raw` and keep their
+logic, which still satisfies the registry invariant.
+
+Stdlib-only and import-light: ``observability.events`` and
+``resilience.faults`` import this before anything heavy loads.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _parse_bool(v):
+    """The framework's uniform boolean-knob convention: only the
+    explicit "off" spellings are False."""
+    return v.strip().lower() not in ("0", "off", "no", "false")
+
+
+class Knob:
+    """One registered environment knob."""
+
+    __slots__ = ("name", "default", "parse", "doc", "kind", "on_error")
+
+    def __init__(self, name, default, parse, doc, kind=None,
+                 on_error="default"):
+        self.name = str(name)
+        self.default = default
+        self.parse = parse
+        self.doc = str(doc)
+        self.kind = kind or getattr(parse, "__name__", "str")
+        if on_error not in ("default", "raise"):
+            raise ValueError(f"on_error={on_error!r}")
+        self.on_error = on_error
+
+
+KNOBS = {}  # name -> Knob, insertion-ordered (doc_table renders in order)
+
+
+def _register(name, default, parse, doc, kind=None, on_error="default"):
+    if name in KNOBS:
+        raise ValueError(f"knob {name!r} registered twice")
+    KNOBS[name] = Knob(name, default, parse, doc, kind=kind,
+                       on_error=on_error)
+
+
+# -- the registry ------------------------------------------------------
+# Grouped by subsystem; `kind` is the display type in the generated
+# README table.  Adding a DK_* read anywhere?  Register it here first —
+# the `knob-read` / `knob-unregistered` lint rules enforce it.
+
+# coordination / multi-host
+_register("DK_COORD_DIR", None, str,
+          "filesystem-rendezvous directory: selects `FileCoordinator` "
+          "(exported per host by `launch.Job(coord_dir=...)`)")
+_register("DK_COORD_RANK", None, int,
+          "this host's coordination rank — REQUIRED with `DK_COORD_DIR` "
+          "(a silent rank-0 default would seat two leaders)")
+_register("DK_COORD_WORLD", None, int,
+          "world size — REQUIRED with `DK_COORD_DIR`")
+_register("DK_COORD_SESSION", "", str,
+          "job-incarnation subdirectory under `DK_COORD_DIR` (the "
+          "auto-resume supervisor rotates it per relaunch wave)")
+_register("DK_COORD_TIMEOUT_S", 120.0, float, kind="seconds",
+          doc="default deadline for every consensus op, the checkpoint "
+              "commit wait and `comm.barrier` (malformed -> 120)")
+_register("DK_COORD_STALE_S", 10.0, float, kind="seconds",
+          doc="heartbeat stale window for dead-peer verdicts — "
+              "launcher and workers judge liveness by this same clock")
+
+# checkpointing
+_register("DK_CKPT_VERIFY", True, _parse_bool, kind="bool",
+          doc="`0` opts out of BOTH integrity-manifest writing and "
+              "restore-side verification")
+_register("DK_CKPT_TWO_PHASE", True, _parse_bool, kind="bool",
+          doc="`0` opts a pod with per-host LOCAL checkpoint dirs out "
+              "of the shared-fs two-phase commit protocol")
+
+# fault injection / chaos
+_register("DK_FAULTS", "", str,
+          "semicolon-separated fault schedule "
+          "`point[@at[xN]][:k=v,...]` (malformed entries fail loudly "
+          "at load time)")
+_register("DK_FAULTS_SEED", None, int, on_error="raise",
+          doc="chaos mode: arm every `faults.KNOWN_POINTS` entry with "
+              "a seeded random schedule (pure function of the seed)")
+_register("DK_FAULTS_RATE", 0.25, float, on_error="raise",
+          doc="chaos: per-point arming probability in [0, 1]")
+_register("DK_FAULTS_HORIZON", 20, int, on_error="raise",
+          doc="chaos: armed points fire at a random call index below "
+              "this horizon")
+_register("DK_FAULTS_POINTS", "", str,
+          "chaos: comma list restricting the armed point set (unknown "
+          "names fail loudly)")
+
+# observability: event log
+_register("DK_OBS_DIR", None, str,
+          "event-log directory — each host appends "
+          "`events-rank_{i}.jsonl`; unset = every emit is a no-op")
+_register("DK_OBS_FLUSH", False, _parse_bool, kind="bool",
+          doc="`1` = fsync after every event line (power-loss durable)")
+_register("DK_OBS_ROTATE_MB", 0.0, float, kind="MB",
+          doc="size cap per event file before rotation to `.jsonl.1...`;"
+              " unset/0 = never rotate")
+_register("DK_OBS_ROTATE_KEEP", 3, int,
+          "rotated event segments retained per host")
+
+# observability: telemetry plane
+_register("DK_OBS_SAMPLE_S", None, float, kind="seconds",
+          doc="metrics-sampler cadence; unset = no sampler thread, no "
+              "series (malformed = sampler stays off)")
+_register("DK_OBS_TS_WINDOW", 512, int,
+          "time-series ring size per metric")
+_register("DK_WATCHDOG", True, _parse_bool, kind="bool",
+          doc="`0`/`off` = the auto-started sampler skips the default "
+              "watchdog rule set")
+_register("DK_METRICS_PORT", None, int, kind="port",
+          doc="arm the standalone per-host Prometheus exporter on this "
+              "port (`/metrics`, `/metricsz`, `/healthz`)")
+
+# alerting
+_register("DK_ALERT_CMD", None, str,
+          "operator webhook: every alert is piped as one JSON line to "
+          "this shell command's stdin (best-effort, never kills the "
+          "run)")
+_register("DK_ALERT_CMD_TIMEOUT_S", 10.0, float, kind="seconds",
+          doc="webhook command timeout")
+
+# serving
+_register("DK_SERVE_PORT", None, int, kind="port",
+          doc="the port a launched serving job binds (exported per "
+              "host by `launch.Job(serve_port=...)`)")
+
+
+# -- access ------------------------------------------------------------
+
+def _lookup(name):
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unregistered environment knob {name!r}: every DK_* knob "
+            "must be declared in dist_keras_tpu/utils/knobs.py (name, "
+            "default, parser, doc) — the registry the README tables "
+            "and the static analyzer are generated from/checked "
+            "against")
+    return knob
+
+
+def raw(name):
+    """``os.environ.get(name)`` for a REGISTERED knob: the raw string,
+    or None when unset.  Re-read per call (no caching) so launcher-
+    exported values win regardless of import order.  Call sites with
+    bespoke parsing/validation use this and keep their logic."""
+    _lookup(name)
+    return os.environ.get(name)
+
+
+def get(name):
+    """The knob's parsed value: registered default when unset/empty,
+    else ``parse(value)``.  A malformed value either falls back to the
+    default or raises a loud ValueError, per the knob's registered
+    ``on_error`` policy."""
+    knob = _lookup(name)
+    value = os.environ.get(name)
+    if value is None or not value.strip():
+        return knob.default
+    try:
+        return knob.parse(value.strip())
+    except (ValueError, TypeError):
+        if knob.on_error == "raise":
+            raise ValueError(
+                f"malformed {name}={value!r}: expected {knob.kind}")
+        return knob.default
+
+
+def doc_table():
+    """The registry rendered as the markdown knob table the README
+    carries (and the analyzer checks) — `python -m
+    dist_keras_tpu.analysis --knob-table` prints exactly this."""
+    lines = ["| knob | type | default | meaning |", "|---|---|---|---|"]
+    for knob in KNOBS.values():
+        if knob.default is None:
+            default = "—"
+        elif knob.default == "":
+            default = '`""`'
+        else:
+            default = f"`{knob.default}`"
+        doc = " ".join(knob.doc.split())
+        lines.append(
+            f"| `{knob.name}` | {knob.kind} | {default} | {doc} |")
+    return "\n".join(lines)

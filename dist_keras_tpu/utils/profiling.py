@@ -58,6 +58,9 @@ class StepTimer:
     """
 
     def __init__(self, name=None):
+        # dklint: ignore[metric-dynamic] caller-chosen instrument
+        # name: a named StepTimer registers under whatever vocabulary
+        # its owner uses (the registry cannot enumerate user names)
         self._hist = (_metrics.histogram(name) if name
                       else _metrics.Histogram())
         self._t0 = None

@@ -784,6 +784,37 @@ sys.exit(0)
 """
 
 
+def run_lint_gate(timeout=180):
+    """-> gate record: the dklint static-analysis tier.  Shells
+    ``python -m dist_keras_tpu.analysis --json`` over the package with
+    the shipped baseline and fails on any fresh finding — every source
+    invariant (fault/knob/event/metric registry sync, signal-handler
+    purity, audited broad excepts) enforced on every gate run."""
+    t0 = time.time()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    rec = {"gate": "static_lint", "platform": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dist_keras_tpu.analysis",
+             "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+        doc = json.loads(proc.stdout)
+        rec.update({
+            "passed": proc.returncode == 0,
+            "exit_code": proc.returncode,
+            "fresh_findings": doc.get("fresh"),
+            "baselined": doc.get("baselined"),
+            "counts": doc.get("counts", {}),
+            "findings": doc.get("findings", [])[:20],
+        })
+    except (subprocess.TimeoutExpired, ValueError, OSError) as e:
+        rec.update({"passed": False, "error": repr(e)})
+    rec["seconds"] = round(time.time() - t0, 2)
+    return rec
+
+
 def run_watchdog_gate(timeout=300):
     """-> gate record: the continuous-perf-telemetry acceptance (see
     _WATCHDOG_WORKER).  A seeded slow-step injection on rank 1 must
@@ -1415,6 +1446,11 @@ def main():
                          "seeded randomized-fault 2-process runs + "
                          "corruption quarantine + supervise "
                          "resume/giveup) and print its record")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run just the dklint static-analysis gate "
+                         "(python -m dist_keras_tpu.analysis over the "
+                         "package, shipped baseline) and print its "
+                         "record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -1422,6 +1458,11 @@ def main():
                          "prometheus-visible, <5%% sampling overhead) "
                          "and print its record")
     args = ap.parse_args()
+
+    if args.lint_only:
+        lint_gate = run_lint_gate()
+        print(json.dumps(lint_gate, indent=1))
+        return 0 if lint_gate["passed"] else 1
 
     if args.watchdog_only:
         wd_gate = run_watchdog_gate()
@@ -1454,6 +1495,7 @@ def main():
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_chaos_gate())
     res["gates"].append(run_watchdog_gate())
+    res["gates"].append(run_lint_gate())
     import platform
 
     doc = {

@@ -1,0 +1,874 @@
+"""dklint (dist_keras_tpu/analysis) — golden fixtures per rule, waiver
+and baseline semantics, and the real-tree self-check that makes tier-1
+enforce every source invariant.
+
+Each rule gets a minimal VIOLATING snippet and a CLEAN one; fixture
+trees are linted by the same passes as the real package because the
+analyzer extracts registries from the AST instead of importing them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dist_keras_tpu.analysis import (
+    RULES,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from dist_keras_tpu.analysis.__main__ import main as dklint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "dist_keras_tpu")
+
+
+def lint(tmp_path, files, readme=None, rules=None):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    readme_path = None
+    if readme is not None:
+        readme_path = tmp_path / "README.md"
+        readme_path.write_text(textwrap.dedent(readme))
+    return run_analysis(
+        str(tmp_path),
+        readme=str(readme_path) if readme_path else None,
+        rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+FAULTS_FIXTURE = """
+    KNOWN_POINTS = ("a.save", "b.load")
+
+
+    def fault_point(name, value=None):
+        return value
+"""
+
+EVENTS_FIXTURE = """
+    KNOWN_EVENTS = ("boot", "halt")
+
+
+    def emit(kind, **fields):
+        try:
+            pass
+        except Exception:
+            pass
+"""
+
+METRICS_FIXTURE = """
+    KNOWN_METRICS = {"a.b": "counter", "q.depth": "gauge",
+                     "span.*": "histogram"}
+"""
+
+KNOBS_FIXTURE = """
+    KNOBS = {}
+
+
+    def _register(name, default, parse, doc):
+        KNOBS[name] = (default, parse, doc)
+
+
+    _register("DK_A", None, str, "knob a")
+    _register("DK_B_S", 1.0, float, "knob b")
+"""
+
+
+# -- registry rules: fault points --------------------------------------
+
+def test_fault_point_unknown(tmp_path):
+    fs = lint(tmp_path, {
+        "faults.py": FAULTS_FIXTURE,
+        "x.py": """
+            from faults import fault_point
+
+            fault_point("c.boom")
+            fault_point("a.save")
+        """}, rules=["fault-point-unknown"])
+    assert [f.rule for f in fs] == ["fault-point-unknown"]
+    assert fs[0].path == "x.py" and fs[0].line == 4
+    assert "c.boom" in fs[0].message
+
+
+def test_fault_point_dynamic_requires_annotation(tmp_path):
+    files = {
+        "faults.py": FAULTS_FIXTURE,
+        "x.py": """
+            from faults import fault_point
+
+
+            def go(point):
+                fault_point(point)
+        """}
+    fs = lint(tmp_path, files, rules=["fault-point-dynamic"])
+    assert rules_of(fs) == ["fault-point-dynamic"]
+    files["x.py"] = """
+        from faults import fault_point
+
+
+        def go(point):
+            # dklint: fault-points=a.save,b.load
+            fault_point(point)
+    """
+    fs = lint(tmp_path, files,
+              rules=["fault-point-dynamic", "fault-point-unknown",
+                     "fault-point-unused"])
+    assert fs == []  # annotation declares them AND marks both as used
+
+
+def test_fault_point_unused(tmp_path):
+    fs = lint(tmp_path, {
+        "faults.py": FAULTS_FIXTURE,
+        "x.py": """
+            from faults import fault_point
+
+            fault_point("a.save")
+        """}, rules=["fault-point-unused"])
+    assert [f.rule for f in fs] == ["fault-point-unused"]
+    assert "b.load" in fs[0].message and fs[0].path == "faults.py"
+
+
+# -- registry rules: knobs ---------------------------------------------
+
+def test_knob_read_bypasses_registry(tmp_path):
+    fs = lint(tmp_path, {
+        "utils/knobs.py": KNOBS_FIXTURE,
+        "x.py": """
+            import os
+
+            a = os.environ.get("DK_A")
+            b = os.getenv("DK_B_S")
+            c = os.environ["DK_A"]
+            d = "DK_A" in os.environ
+            e = os.environ.get("OTHER_VAR")  # non-DK: fine
+        """}, rules=["knob-read"])
+    assert [f.rule for f in fs] == ["knob-read"] * 4
+    assert [f.line for f in fs] == [4, 5, 6, 7]
+
+
+def test_knob_read_allowed_inside_knobs_py(tmp_path):
+    fs = lint(tmp_path, {
+        "utils/knobs.py": KNOBS_FIXTURE + """
+    import os
+
+    value = os.environ.get("DK_A")
+"""}, rules=["knob-read"])
+    assert fs == []
+
+
+def test_knob_unregistered(tmp_path):
+    fs = lint(tmp_path, {
+        "utils/knobs.py": KNOBS_FIXTURE,
+        "x.py": """
+            from dist_keras_tpu.utils import knobs
+
+            ok = knobs.raw("DK_A")
+            bad = knobs.get("DK_NOPE")
+        """}, rules=["knob-unregistered"])
+    assert [f.rule for f in fs] == ["knob-unregistered"]
+    assert "DK_NOPE" in fs[0].message and fs[0].line == 5
+
+
+def test_knob_doc_sync(tmp_path):
+    readme = """
+        | knob | meaning |
+        |---|---|
+        | `DK_A` | documented |
+        | `DK_GHOST` | never registered |
+    """
+    fs = lint(tmp_path, {"utils/knobs.py": KNOBS_FIXTURE},
+              readme=readme,
+              rules=["knob-undocumented", "knob-doc-drift"])
+    got = {(f.rule, f.message.split()[
+        {"knob-undocumented": 2, "knob-doc-drift": 3}[f.rule]])
+        for f in fs}
+    assert ("knob-undocumented", "DK_B_S") in got
+    assert ("knob-doc-drift", "DK_GHOST") in got
+    assert len(fs) == 2
+
+
+# -- registry rules: events --------------------------------------------
+
+def test_event_unregistered_and_dynamic(tmp_path):
+    fs = lint(tmp_path, {
+        "events.py": EVENTS_FIXTURE,
+        "x.py": """
+            from events import emit
+
+            emit("boot")
+            emit("mystery")
+            emit(kind)
+        """}, rules=["event-unregistered", "event-dynamic"])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("event-unregistered", 5), ("event-dynamic", 6)]
+    assert "mystery" in fs[0].message
+
+
+def test_event_dynamic_annotation(tmp_path):
+    fs = lint(tmp_path, {
+        "events.py": EVENTS_FIXTURE,
+        "x.py": """
+            from events import emit
+
+            # dklint: events=boot,halt
+            emit(kind)
+        """}, rules=["event-unregistered", "event-dynamic"])
+    assert fs == []
+
+
+def test_event_doc_sync(tmp_path):
+    readme = """
+        <!-- dklint: events-table -->
+        | kind | emitted by |
+        |---|---|
+        | `boot` | somewhere |
+        | `phantom` | nowhere |
+    """
+    fs = lint(tmp_path, {"events.py": EVENTS_FIXTURE}, readme=readme,
+              rules=["event-undocumented", "event-doc-drift"])
+    got = {(f.rule, "halt" in f.message, "phantom" in f.message)
+           for f in fs}
+    assert got == {("event-undocumented", True, False),
+                   ("event-doc-drift", False, True)}
+
+
+def test_event_table_marker_required(tmp_path):
+    fs = lint(tmp_path, {"events.py": EVENTS_FIXTURE},
+              readme="no tables here\n",
+              rules=["event-undocumented"])
+    assert len(fs) == 1 and "marker" in fs[0].message
+
+
+# -- registry rules: metrics -------------------------------------------
+
+def test_metric_unregistered_kind_and_dynamic(tmp_path):
+    fs = lint(tmp_path, {
+        "metrics.py": METRICS_FIXTURE,
+        "x.py": """
+            from observability import metrics
+
+            metrics.counter("a.b").inc()            # registered
+            metrics.counter("zz.unknown").inc()     # not registered
+            metrics.gauge("a.b").set(1)             # kind mismatch
+            metrics.histogram(f"span.{p}").observe(1.0)  # dynamic
+        """}, rules=["metric-unregistered", "metric-dynamic"])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("metric-unregistered", 5), ("metric-unregistered", 6),
+        ("metric-dynamic", 7)]
+    assert "registered as a counter, not a gauge" in fs[1].message
+
+
+def test_metric_dynamic_annotation(tmp_path):
+    fs = lint(tmp_path, {
+        "metrics.py": METRICS_FIXTURE,
+        "x.py": """
+            from observability import metrics
+
+            # dklint: metrics=span.*
+            metrics.histogram(f"span.{p}").observe(1.0)
+        """}, rules=["metric-unregistered", "metric-dynamic"])
+    assert fs == []
+
+
+def test_metric_literal_matches_pattern(tmp_path):
+    fs = lint(tmp_path, {
+        "metrics.py": METRICS_FIXTURE,
+        "x.py": """
+            from observability import metrics
+
+            metrics.histogram("span.train.step").observe(1.0)
+        """}, rules=["metric-unregistered", "metric-dynamic"])
+    assert fs == []
+
+
+def test_metric_collision(tmp_path):
+    fs = lint(tmp_path, {
+        "metrics.py": """
+            KNOWN_METRICS = {"a.b": "gauge", "a_b": "gauge"}
+        """}, rules=["metric-collision"])
+    assert [f.rule for f in fs] == ["metric-collision"]
+    assert "dk_a_b" in fs[0].message
+
+
+def test_metric_doc_sync(tmp_path):
+    readme = """
+        <!-- dklint: metrics-table -->
+        | metric | kind |
+        |---|---|
+        | `a.b` | counter |
+        | `span.*` | histogram |
+        | `ghost.metric` | counter |
+    """
+    fs = lint(tmp_path, {"metrics.py": METRICS_FIXTURE},
+              readme=readme,
+              rules=["metric-undocumented", "metric-doc-drift"])
+    got = {(f.rule, "q.depth" in f.message, "ghost.metric" in f.message)
+           for f in fs}
+    assert got == {("metric-undocumented", True, False),
+                   ("metric-doc-drift", False, True)}
+
+
+def test_knob_table_strict_sync(tmp_path):
+    """With the knobs-table marker present, a default/doc/kind edit on
+    either side is a knob-doc-drift finding, not just name presence."""
+    knobs_src = """
+        KNOBS = {}
+
+
+        def _register(name, default, parse, doc, kind=None):
+            KNOBS[name] = (default, parse, doc)
+
+
+        _register("DK_A", 5.0, float, "knob a")
+    """
+    readme_ok = """
+        <!-- dklint: knobs-table -->
+        | knob | type | default | meaning |
+        |---|---|---|---|
+        | `DK_A` | float | `5.0` | knob a |
+    """
+    fs = lint(tmp_path, {"utils/knobs.py": knobs_src},
+              readme=readme_ok,
+              rules=["knob-undocumented", "knob-doc-drift"])
+    assert fs == []
+    readme_stale = readme_ok.replace("`5.0`", "`9.0`")
+    fs = lint(tmp_path, {"utils/knobs.py": knobs_src},
+              readme=readme_stale,
+              rules=["knob-undocumented", "knob-doc-drift"])
+    assert rules_of(fs) == ["knob-doc-drift"]
+    assert any("out of sync" in f.message and "DK_A" in f.message
+               for f in fs)
+
+
+def test_knob_table_reconstruction_matches_doc_table():
+    """The analyzer's AST row reconstruction is pinned to the real
+    knobs.doc_table() output — the mirror cannot drift silently."""
+    from dist_keras_tpu.analysis import core as _core
+    from dist_keras_tpu.analysis import registries as _registries
+    from dist_keras_tpu.utils import knobs
+
+    project = _core.load_tree(PKG)
+    regs = _registries._extract_registries(project)
+    rows = _registries._knob_table_rows(regs["knobs"])
+    assert rows == knobs.doc_table().splitlines()[2:]
+
+
+def test_syntax_error_rule_survives_rules_filter(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    fs = run_analysis(str(tmp_path), rules=["knob-read"])
+    assert [f.rule for f in fs] == ["syntax-error"]
+    assert fs[0].path == "broken.py"
+
+
+def test_prom_sanitization_parity():
+    """The analyzer's mirrored sanitizer must track the real one."""
+    from dist_keras_tpu.analysis.registries import prom_name
+    from dist_keras_tpu.observability import prometheus
+
+    for name in ("a.b", "serve.reload.skipped_corrupt", "9lead",
+                 "weird-name!x"):
+        assert prom_name(name, "gauge") == prometheus.metric_name(name)
+        assert prom_name(name, "counter") == \
+            prometheus.metric_name(name) + "_total"
+
+
+# -- purity: signal safety and never-raise -----------------------------
+
+def test_signal_unsafe_lock(tmp_path):
+    fs = lint(tmp_path, {
+        "p.py": """
+            import signal
+            import threading
+
+            _lock = threading.Lock()
+
+
+            def _handler(signum, frame):
+                with _lock:
+                    pass
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _handler)
+        """}, rules=["signal-unsafe"])
+    assert [f.rule for f in fs] == ["signal-unsafe"]
+    assert "lock" in fs[0].message and fs[0].line == 9
+
+
+def test_signal_unsafe_emit_through_call_graph(tmp_path):
+    fs = lint(tmp_path, {
+        "p.py": """
+            import signal
+
+
+            def _note():
+                emit("sig")
+
+
+            def _handler(signum, frame):
+                _note()
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _handler)
+        """}, rules=["signal-unsafe"])
+    assert [f.rule for f in fs] == ["signal-unsafe"]
+    assert "emission" in fs[0].message
+
+
+def test_signal_unsafe_io_and_allowlist(tmp_path):
+    fs = lint(tmp_path, {
+        "p.py": """
+            import os
+            import signal
+
+
+            def _handler(signum, frame):
+                os.kill(os.getpid(), signum)   # allowlisted escalation
+                signal.signal(signum, signal.SIG_DFL)
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _handler)
+        """}, rules=["signal-unsafe"])
+    assert fs == []
+    fs = lint(tmp_path, {
+        "q.py": """
+            import signal
+            import time
+
+
+            def _handler(signum, frame):
+                time.sleep(0.1)
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _handler)
+        """}, rules=["signal-unsafe"])
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+
+
+def test_signal_unsafe_cross_module(tmp_path):
+    """The walker follows calls into OTHER analyzed files through both
+    from-import forms (module and function)."""
+    helpers = """
+        import threading
+
+        _lock = threading.Lock()
+
+
+        def noisy():
+            with _lock:
+                pass
+    """
+    via_module = """
+        import signal
+
+        from mypkg import helpers
+
+
+        def _handler(signum, frame):
+            helpers.noisy()
+
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+    """
+    fs = lint(tmp_path / "a", {"helpers.py": helpers,
+                               "p.py": via_module},
+              rules=["signal-unsafe"])
+    assert len(fs) == 1 and fs[0].path == "helpers.py" \
+        and "lock" in fs[0].message
+    via_function = """
+        import signal
+
+        from mypkg.helpers import noisy
+
+
+        def _handler(signum, frame):
+            noisy()
+
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+    """
+    fs = lint(tmp_path / "b", {"helpers.py": helpers,
+                               "q.py": via_function},
+              rules=["signal-unsafe"])
+    assert len(fs) == 1 and fs[0].path == "helpers.py"
+
+
+def test_obs_must_not_raise(tmp_path):
+    bad = {
+        "events.py": """
+            def emit(kind, **fields):
+                _writer.emit(kind, **fields)
+        """}
+    fs = lint(tmp_path, bad, rules=["obs-must-not-raise"])
+    assert [f.rule for f in fs] == ["obs-must-not-raise"]
+    assert "emit" in fs[0].message
+    good = {
+        "events.py": """
+            def emit(kind, **fields):
+                try:
+                    _writer.emit(kind, **fields)
+                except Exception:
+                    pass
+        """}
+    assert lint(tmp_path, good, rules=["obs-must-not-raise"]) == []
+
+
+# -- hygiene -----------------------------------------------------------
+
+def test_broad_except_flagged_and_waived(tmp_path):
+    fs = lint(tmp_path, {
+        "x.py": """
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except:
+                pass
+        """}, rules=["broad-except"])
+    assert [f.line for f in fs] == [4, 8]
+    fs = lint(tmp_path, {
+        "x.py": """
+            try:
+                work()
+            # dklint: ignore[broad-except] best-effort probe
+            except Exception:
+                pass
+        """}, rules=["broad-except"])
+    assert fs == []
+
+
+def test_broad_except_base_exception_not_an_evasion(tmp_path):
+    """`except BaseException` is broader, not exempt."""
+    fs = lint(tmp_path, {
+        "x.py": """
+            try:
+                work()
+            except BaseException:
+                pass
+            try:
+                work()
+            except (ValueError, BaseException):
+                pass
+        """}, rules=["broad-except"])
+    assert [f.line for f in fs] == [4, 8]
+
+
+def test_write_baseline_ignores_rules_filter(tmp_path, capsys):
+    """--write-baseline grandfathers the UNFILTERED findings even when
+    --rules narrows the reporting run."""
+    (tmp_path / "faults.py").write_text(
+        textwrap.dedent(FAULTS_FIXTURE))
+    (tmp_path / "x.py").write_text(textwrap.dedent("""
+        from faults import fault_point
+
+        fault_point("c.boom")
+        try:
+            work()
+        except Exception:
+            pass
+    """))
+    baseline = tmp_path / "bl.json"
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--rules", "broad-except",
+                      "--baseline", str(baseline),
+                      "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    fingerprints = load_baseline(str(baseline))
+    rules_in_baseline = {fp.split("::")[0] for fp in fingerprints}
+    assert "fault-point-unknown" in rules_in_baseline  # not dropped
+    assert "broad-except" in rules_in_baseline
+    # the full run is now clean against that baseline
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_waiver_comment_block_above(tmp_path):
+    """A waiver anywhere in the contiguous comment block applies."""
+    fs = lint(tmp_path, {
+        "x.py": """
+            try:
+                work()
+            # dklint: ignore[broad-except] the reason starts here and
+            # continues over a second comment line before the site
+            except Exception:
+                pass
+        """}, rules=["broad-except"])
+    assert fs == []
+
+
+def test_waiver_multiple_rules_one_comment(tmp_path):
+    """One ignore[...] can list several rules; each applies at ITS OWN
+    site (the waiver scope is the flagged line + the comment block
+    directly above it, deliberately not a whole try/except)."""
+    fs = lint(tmp_path, {
+        "serving/x.py": """
+            def go():
+                try:
+                    work()
+                # dklint: ignore[broad-except,untyped-raise] deliberate
+                except Exception:
+                    handle()
+                # dklint: ignore[untyped-raise,broad-except] deliberate
+                raise RuntimeError("waived too")
+        """}, rules=["broad-except", "untyped-raise"])
+    assert fs == []
+    # the same snippet without the second waiver still flags the raise:
+    # a waiver above the except does NOT leak to the raise below it
+    fs = lint(tmp_path, {
+        "serving/x.py": """
+            def go():
+                try:
+                    work()
+                # dklint: ignore[broad-except,untyped-raise] deliberate
+                except Exception:
+                    raise RuntimeError("not covered by the line above")
+        """}, rules=["broad-except", "untyped-raise"])
+    assert [f.rule for f in fs] == ["untyped-raise"]
+
+
+def test_untyped_raise_scope(tmp_path):
+    fs = lint(tmp_path, {
+        "serving/x.py": """
+            def go():
+                raise RuntimeError("untyped")
+
+
+            def ok():
+                raise ValueError("config contract: fine")
+        """,
+        "data/y.py": """
+            def elsewhere():
+                raise RuntimeError("out of the typed-contract scope")
+        """}, rules=["untyped-raise"])
+    assert [(f.path, f.line) for f in fs] == [("serving/x.py", 3)]
+
+
+def test_jit_impure(tmp_path):
+    fs = lint(tmp_path, {
+        "x.py": """
+            import time
+
+            import jax
+
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+
+
+            fast = jax.jit(lambda x: x + random.random())
+
+
+            def clean(x):
+                return time.time(), x
+        """}, rules=["jit-impure"])
+    assert [(f.line, "time.time()" in f.message or
+             "random.random()" in f.message) for f in fs] == [
+        (9, True), (12, True)]
+
+
+# -- baseline + CLI ----------------------------------------------------
+
+def test_baseline_grandfathers_then_catches_new(tmp_path):
+    files = {
+        "x.py": """
+            try:
+                work()
+            except Exception:
+                pass
+        """}
+    findings = lint(tmp_path, files, rules=["broad-except"])
+    assert len(findings) == 1
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), findings)
+    fingerprints = load_baseline(str(baseline))
+
+    # the same finding is grandfathered...
+    again = lint(tmp_path, files, rules=["broad-except"])
+    fresh = apply_baseline(again, fingerprints)
+    assert fresh == [] and again[0].baselined
+
+    # ...and stays grandfathered when unrelated lines shift it down
+    # (fingerprints are line-number-free)
+    moved_src = textwrap.dedent("""
+        # a new leading comment
+        # another one
+
+
+        try:
+            work()
+        except Exception:
+            pass
+    """)
+    files["x.py"] = moved_src
+    moved = lint(tmp_path, files, rules=["broad-except"])
+    assert apply_baseline(moved, fingerprints) == []
+
+    # a NEW violation in another function is NOT masked
+    files["x.py"] = moved_src + textwrap.dedent("""
+        def other():
+            try:
+                work()
+            except Exception:
+                pass
+    """)
+    both = lint(tmp_path, files, rules=["broad-except"])
+    fresh = apply_baseline(both, fingerprints)
+    assert len(both) == 2 and len(fresh) == 1
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    (tmp_path / "x.py").write_text(textwrap.dedent("""
+        try:
+            work()
+        except Exception:
+            pass
+    """))
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["fresh"] == 1
+    assert doc["findings"][0]["rule"] == "broad-except"
+
+    # --write-baseline grandfathers it; the next run exits 0
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--write-baseline"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "1 baselined" in out
+
+    # --no-baseline reports it again
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_acceptance_demo_fault_point(tmp_path, capsys):
+    """The issue's acceptance demo: adding a fault_point call without a
+    KNOWN_POINTS entry exits nonzero naming the rule and file:line."""
+    (tmp_path / "faults.py").write_text(textwrap.dedent(FAULTS_FIXTURE))
+    (tmp_path / "x.py").write_text(
+        'from faults import fault_point\n'
+        'fault_point("new.seam")\n')
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--rules", "fault-point-unknown"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fault-point-unknown x.py:2" in out
+
+
+def test_rules_filter_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_analysis(PKG, rules=["no-such-rule"])
+
+
+# -- the real tree -----------------------------------------------------
+
+def test_rule_docs_complete():
+    assert set(RULES) == {
+        "syntax-error",
+        "fault-point-unknown", "fault-point-dynamic",
+        "fault-point-unused", "knob-read", "knob-unregistered",
+        "knob-undocumented", "knob-doc-drift", "event-unregistered",
+        "event-dynamic", "event-undocumented", "event-doc-drift",
+        "metric-unregistered", "metric-dynamic", "metric-collision",
+        "metric-undocumented", "metric-doc-drift", "signal-unsafe",
+        "obs-must-not-raise", "broad-except", "untyped-raise",
+        "jit-impure"}
+
+
+def test_real_tree_is_clean_with_shipped_baseline():
+    """The self-check: the package passes its own analyzer in-process
+    (the shipped baseline is empty, so this asserts ZERO findings)."""
+    findings = run_analysis(PKG, readme=os.path.join(REPO, "README.md"))
+    fingerprints = load_baseline(
+        os.path.join(PKG, "analysis", "baseline.json"))
+    fresh = apply_baseline(findings, fingerprints)
+    assert fresh == [], [f"{f.rule} {f.path}:{f.line}" for f in fresh]
+
+
+def test_cli_subprocess_real_tree():
+    """CI enforcement: `python -m dist_keras_tpu.analysis` exits 0 on
+    the tree with the shipped baseline — the tier-1 lint gate."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dist_keras_tpu.analysis", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["fresh"] == 0
+
+
+def test_knob_table_cli(capsys):
+    rc = dklint_main(["--knob-table"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    from dist_keras_tpu.utils import knobs
+
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in out
+
+
+# -- the knob registry itself ------------------------------------------
+
+def test_knobs_get_defaults_and_parsing(monkeypatch):
+    from dist_keras_tpu.utils import knobs
+
+    monkeypatch.delenv("DK_COORD_TIMEOUT_S", raising=False)
+    assert knobs.get("DK_COORD_TIMEOUT_S") == 120.0
+    monkeypatch.setenv("DK_COORD_TIMEOUT_S", "7.5")
+    assert knobs.get("DK_COORD_TIMEOUT_S") == 7.5
+    monkeypatch.setenv("DK_COORD_TIMEOUT_S", "junk")
+    assert knobs.get("DK_COORD_TIMEOUT_S") == 120.0  # silent fallback
+
+    monkeypatch.setenv("DK_FAULTS_RATE", "bad")
+    with pytest.raises(ValueError, match="DK_FAULTS_RATE"):
+        knobs.get("DK_FAULTS_RATE")  # schedule knobs fail loudly
+
+    monkeypatch.setenv("DK_CKPT_VERIFY", "off")
+    assert knobs.get("DK_CKPT_VERIFY") is False
+    monkeypatch.setenv("DK_CKPT_VERIFY", "1")
+    assert knobs.get("DK_CKPT_VERIFY") is True
+
+
+def test_knobs_raw_requires_registration(monkeypatch):
+    from dist_keras_tpu.utils import knobs
+
+    monkeypatch.setenv("DK_COORD_DIR", "/tmp/x")
+    assert knobs.raw("DK_COORD_DIR") == "/tmp/x"
+    with pytest.raises(KeyError, match="unregistered"):
+        knobs.raw("DK_TOTALLY_NEW")
+
+
+def test_knobs_doc_table_covers_registry():
+    from dist_keras_tpu.utils import knobs
+
+    table = knobs.doc_table()
+    assert table.splitlines()[0].startswith("| knob ")
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table

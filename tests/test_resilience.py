@@ -711,3 +711,19 @@ def test_streaming_predictor_fatal_fetch_propagates():
     faults.inject("stream.fetch", at=0, times=1)  # FaultInjected: fatal
     with pytest.raises(FaultInjected):
         pred.run(src, lambda rows, preds: None)
+
+
+def test_load_env_custom_var_name(monkeypatch):
+    """load_env(var=...) with a caller-supplied (unregistered) variable
+    stays a plain env read — the knob registry only intercepts the
+    default DK_FAULTS (round-12 regression guard)."""
+    from dist_keras_tpu.resilience import faults
+
+    monkeypatch.setenv("MY_CUSTOM_FAULTS", "stream.fetch@0")
+    faults.clear()
+    try:
+        faults.load_env(var="MY_CUSTOM_FAULTS", force=True)
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("stream.fetch")
+    finally:
+        faults.clear()
