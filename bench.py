@@ -47,9 +47,13 @@ in run order:
    walls) for a streamed windowed trainer, CPU-pinned subprocess; the
    warm-run retrace delta is the "no steady-state retraces" claim.
    Also runs in the backend-unresponsive early-exit path.
-11. Transformer — composite dp x tp x sp step (ring + flash attention);
+11. Reshard restore — restore wall of one promoted world-2 step
+   same-world vs through the world-1 elastic resharding path (verify
+   every manifest, gather by global index, re-split), CPU-pinned
+   subprocess; also runs in the backend-unresponsive early-exit path.
+12. Transformer — composite dp x tp x sp step (ring + flash attention);
    new capability, no reference counterpart (vs_baseline: null).
-12. Long-context — T=32k causal step, flash kernels + remat="mlp";
+13. Long-context — T=32k causal step, flash kernels + remat="mlp";
    reports hardware MFU (attention-aware) AND param-only MFU.
 
 Baseline denominators (measured in this image with Keras 3 + TF CPU
@@ -832,6 +836,80 @@ print(json.dumps({
 """
 
 
+# The reshard-restore worker: restore wall of the SAME promoted bytes
+# through the two load paths — a same-world per-rank restore (world-2
+# rank 0 reading its own payload) vs the elastic resharding restore
+# (world-1 reading BOTH payloads, verifying each manifest, gathering
+# the sharded leaves by global index and re-splitting) — so the price
+# of "run continues smaller" is tracked per round, not asserted once.
+# CPU-pinned subprocess like every host-side row: it must still
+# measure when the device tunnel is wedged.
+_RESHARD_WORKER = r"""
+import json, os, statistics, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.resilience import elastic
+
+mb, reps = int(sys.argv[1]), int(sys.argv[2])
+n = mb * 1024 * 1024 // 8
+g = {"w": np.random.default_rng(0).standard_normal(n),
+     "i": np.int64(1)}
+dims = {"w": 0, "i": None}
+work = tempfile.mkdtemp(prefix="dk_bench_reshard_")
+ck_dir = os.path.join(work, "ck")
+# a world-2 two-phase save (non-leader publishes its marker first, the
+# leader's save then promotes) of the sharded halves
+for rank in (1, 0):
+    local = {"w": elastic.split_leaf(g["w"], 0, 2, rank),
+             "i": g["i"]}
+    Checkpointer(ck_dir, rank=rank, world=2).save(
+        1, local, shard_specs=dims)
+
+same_ck = Checkpointer(ck_dir, rank=0, world=2)
+reshard_ck = Checkpointer(ck_dir, rank=0, world=1)
+same, reshard = [], []
+same_ck.restore()  # warm both paths' one-time import/fs costs
+reshard_ck.restore()
+for _ in range(reps):
+    t0 = time.perf_counter()
+    same_ck.restore()
+    same.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    step, st = reshard_ck.restore()
+    reshard.append(time.perf_counter() - t0)
+assert np.array_equal(np.asarray(st["w"]), g["w"])
+import shutil
+shutil.rmtree(work, ignore_errors=True)
+s, r = statistics.median(same), statistics.median(reshard)
+print(json.dumps({
+    "payload_mb": mb,
+    "saved_world": 2,
+    "restore_s_same_world": round(s, 4),
+    "restore_s_reshard": round(r, 4),
+    "reshard_overhead_s": round(r - s, 4),
+    "reshard_over_same": round(r / s, 4) if s else None,
+    "reps": reps,
+}))
+"""
+
+
+def bench_reshard_restore(peak=None, mb=64, reps=5, timeout_s=300):
+    """Elastic-restore cost: the wall of restoring one promoted
+    world-2 step same-world (per-rank payload read) vs through the
+    world-1 resharding path (verify every manifest, gather by global
+    index, re-split) — the recovery-latency price of an elastic
+    resize, measured per round.  No ``vs_baseline`` (the reference has
+    no elasticity story beyond Spark partition re-runs)."""
+    return _run_cpu_worker(
+        "reshard_restore", source=_RESHARD_WORKER,
+        args=(mb, reps),
+        strip_prefixes=("DK_CKPT", "DK_COORD", "DK_ELASTIC"),
+        timeout_s=timeout_s)
+
+
 def bench_ckpt_manifest(peak=None, mb=64, reps=5, timeout_s=300):
     """Integrity-manifest cost: ``Checkpointer.save`` with vs without
     ``DK_CKPT_VERIFY`` (median-of-``reps`` on a ``mb``-MB pytree) plus
@@ -993,7 +1071,9 @@ def main():
                                   (bench_ckpt_manifest,
                                    "ckpt_manifest_overhead"),
                                   (bench_retrace_proxy,
-                                   "bench_retrace_proxy")):
+                                   "bench_retrace_proxy"),
+                                  (bench_reshard_restore,
+                                   "reshard_restore")):
             t0 = time.time()
             _obs_emit("bench_config_begin", name=fn.__name__)
             try:
@@ -1022,8 +1102,8 @@ def main():
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
                bench_adag_streamed, bench_serving, bench_ckpt_manifest,
-               bench_retrace_proxy, bench_transformer_tp,
-               bench_long_context):
+               bench_retrace_proxy, bench_reshard_restore,
+               bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,

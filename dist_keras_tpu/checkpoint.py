@@ -60,6 +60,20 @@ promoted step automatically, so a bad disk costs one checkpoint
 cadence instead of the run.  ``latest_verified_step()`` is the
 read-only probe the auto-resume supervisor
 (``resilience.supervisor``) restarts against.
+
+This PR — ELASTIC restore (resume an N-host run on M hosts).  A
+promoted two-phase step records which world wrote it (its per-host
+payload layout); ``saved_world(step)`` reads that count, and
+``restore()`` now detects ``saved_world != current_world`` and — with
+``DK_ELASTIC`` on (the default) — delegates to
+``resilience.elastic.reshard_restore``: every source payload is
+verified against its manifest before it contributes bytes, sharded
+leaves (recorded per-save via ``save(..., shard_specs=...)`` →
+``shard_meta.json`` inside each payload, signed by the manifest) are
+gathered by global index and re-split for the new world, replicated
+leaves take the leader's copy.  Shrink and grow both work; with
+``DK_ELASTIC=0`` the pre-elastic semantics return (grow reads the
+leader replica, a world-mismatched shrink refuses typed).
 """
 
 from __future__ import annotations
@@ -114,6 +128,14 @@ def _verify_enabled():
     knob); a per-call ``restore(verify=...)`` overrides the read side
     only."""
     return knobs.get("DK_CKPT_VERIFY")
+
+
+def _elastic_enabled():
+    """``DK_ELASTIC`` (default on): a restore that finds a checkpoint
+    written by a DIFFERENT world size re-partitions it via
+    ``resilience.elastic.reshard_restore`` instead of refusing (or
+    silently reading the leader replica)."""
+    return knobs.get("DK_ELASTIC")
 
 
 def _two_phase_enabled():
@@ -387,12 +409,7 @@ class Checkpointer:
         silently restoring another host's state (per-host optimizer
         slots, staleness counters) would diverge the run."""
         rank, _world = self._coord_ids()
-        try:
-            names = os.listdir(path)
-        except OSError:
-            names = []
-        hosts = sorted(n for n in names if n.startswith("host_")
-                       and os.path.isdir(os.path.join(path, n)))
+        hosts, wrote = self._host_layout(path)
         if not hosts:
             return path  # single-host layout
         mine = f"host_{rank}"
@@ -401,9 +418,6 @@ class Checkpointer:
         # the writing world is recorded by the promoted host-ok markers
         # (a deleted payload dir must not shrink it and turn a corrupt
         # step into a silent leader-replica fallback)
-        wrote = max(len(hosts),
-                    sum(1 for n in names
-                        if re.fullmatch(r"host-\d+\.ok", n)))
         if rank >= wrote:
             return os.path.join(path, "host_0")
         # dklint: ignore[untyped-raise] deliberate refusal, not a
@@ -414,6 +428,60 @@ class Checkpointer:
             f"missing this rank's payload {mine!r} (present: {hosts}) "
             "— a promoted step should contain every writer's payload; "
             "refusing to silently restore another host's state")
+
+    def _host_layout(self, path):
+        """(host payload dir names, writing-world count) of a promoted
+        step's directory — the single reader of the per-host layout.
+        The writing world is the max of the payload dirs present and
+        the promoted ``host-{i}.ok`` markers, so a deleted payload
+        cannot silently shrink it."""
+        try:
+            names = os.listdir(path)
+        except OSError:
+            names = []
+        # strict host_<N> names only: a stray host_0.tmp staging dir
+        # (raced retry) or operator-created sibling must not crash the
+        # numeric sort every reader runs
+        hosts = sorted(
+            (n for n in names if re.fullmatch(r"host_\d+", n)
+             and os.path.isdir(os.path.join(path, n))),
+            key=lambda n: int(n.split("_")[1]))
+        wrote = max(len(hosts),
+                    sum(1 for n in names
+                        if re.fullmatch(r"host-\d+\.ok", n)))
+        return hosts, wrote
+
+    def saved_world(self, step=None):
+        """How many hosts WROTE ``step`` (default: latest) — 1 for the
+        single-host layout, the per-host payload/marker count for a
+        promoted two-phase step.  Strictly read-only; the elastic
+        restore compares this against the current world to decide
+        whether a resharding load is needed."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        _hosts, wrote = self._host_layout(self._read_path(int(step)))
+        return max(wrote, 1)
+
+    def host_payload_paths(self, step):
+        """Rank-ordered payload directories of EVERY host that wrote
+        ``step`` (the single step dir itself for a single-host save) —
+        what ``resilience.elastic.reshard_restore`` gathers from.  A
+        payload missing from within the writing world is a typed
+        :class:`CheckpointCorrupt` (a promoted step must contain every
+        writer's payload)."""
+        path = self._read_path(int(step))
+        hosts, wrote = self._host_layout(path)
+        if wrote == 0:
+            return [path]
+        expect = [f"host_{r}" for r in range(wrote)]
+        missing = sorted(set(expect) - set(hosts))
+        if missing:
+            raise CheckpointCorrupt(int(step), path, [
+                f"{m}: payload missing (step was written by {wrote} "
+                "hosts)" for m in missing])
+        return [os.path.join(path, n) for n in expect]
 
     def _gc_orphans(self):
         """Writer-side sweep (after a successful commit): remove staging
@@ -491,7 +559,7 @@ class Checkpointer:
                 return None
             time.sleep(float(poll_s))
 
-    def save(self, step, state):
+    def save(self, step, state, shard_specs=None):
         """Atomic, retried commit: tmp-dir write -> fsync -> rename.
 
         A kill at any instant leaves the directory with either the old
@@ -504,6 +572,15 @@ class Checkpointer:
         ``step_N.mh``, the leader promotes the staging directory to the
         committed ``step_N`` only when ALL markers have landed (deadline
         -> typed ``PeerLost``, never a hang).
+
+        ``shard_specs`` (optional): a pytree mirroring ``state`` whose
+        leaves name each leaf's host-sharded dimension (int, a 1-axis
+        ``PartitionSpec``, or None for replicated — e.g.
+        ``parallel.fsdp.fsdp_specs`` output).  Recorded as
+        ``shard_meta.json`` inside this host's payload (signed by the
+        integrity manifest), which is what lets an ELASTIC restore at a
+        different world size gather the shards by global index instead
+        of guessing.
         """
         import time as _time
 
@@ -515,7 +592,8 @@ class Checkpointer:
         rank, world = self._coord_ids()
         if world > 1 and _two_phase_enabled():
             with span("ckpt.save", step=step):
-                self._save_multihost(step, state, rank, world)
+                self._save_multihost(step, state, rank, world,
+                                     shard_specs)
             events.emit("ckpt_save", step=step, world=world,
                         duration_s=_time.perf_counter() - t0)
             return
@@ -524,7 +602,8 @@ class Checkpointer:
         self._inflight = os.path.basename(final)
         try:
             with span("ckpt.save", step=step):
-                self._retry.call(self._save_once, tmp, final, state)
+                self._retry.call(self._save_once, tmp, final, state,
+                                 shard_specs)
             self._gc_orphans()
         finally:
             self._inflight = None
@@ -532,7 +611,7 @@ class Checkpointer:
         events.emit("ckpt_save", step=step, world=world,
                     duration_s=_time.perf_counter() - t0)
 
-    def _write_payload(self, tmp, state):
+    def _write_payload(self, tmp, state, shard_specs=None):
         """Write ``state`` into the staging dir ``tmp`` (clean-slate) and
         fsync it — the write half of every commit protocol here."""
         import shutil
@@ -552,6 +631,15 @@ class Checkpointer:
 
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if shard_specs is not None:
+            # the self-describing half of the elastic contract: the
+            # meta rides INSIDE the payload, BEFORE the manifest, so
+            # the manifest signs it and the commit publishes both
+            from dist_keras_tpu.resilience import elastic as _elastic
+
+            rank, world = self._coord_ids()
+            _elastic.write_shard_meta(tmp, state, shard_specs, world,
+                                      rank)
         if _verify_enabled():
             # the integrity manifest rides INSIDE the staging dir, so
             # the commit rename that publishes the payload publishes
@@ -582,10 +670,10 @@ class Checkpointer:
         if self.fsync:
             _fsync_dir(self.directory)  # persist the renames themselves
 
-    def _save_once(self, tmp, final, state):
+    def _save_once(self, tmp, final, state, shard_specs=None):
         from dist_keras_tpu.resilience.faults import fault_point
 
-        self._write_payload(tmp, state)
+        self._write_payload(tmp, state, shard_specs)
         # the deterministic mid-write kill: tmp written, not yet committed
         fault_point("checkpoint.save")
         self._swap_in(tmp, final)
@@ -599,7 +687,7 @@ class Checkpointer:
     def _marker(self, stage, rank):
         return os.path.join(stage, f"host-{rank}.ok")
 
-    def _save_host_once(self, stage, rank, state):
+    def _save_host_once(self, stage, rank, state, shard_specs=None):
         """Phase 1 on one host: retract own marker -> payload -> fsync
         -> atomic rename -> durable -> publish the ``host-{i}.ok``
         marker LAST.  The retraction runs on EVERY attempt (this
@@ -619,7 +707,7 @@ class Checkpointer:
             pass
         hostdir = os.path.join(stage, f"host_{rank}")
         tmp = hostdir + ".tmp"
-        self._write_payload(tmp, state)
+        self._write_payload(tmp, state, shard_specs)
         # mid-write kill: payload staged, this host's rename not yet done
         fault_point("checkpoint.save")
         shutil.rmtree(hostdir, ignore_errors=True)  # stale earlier attempt
@@ -686,7 +774,8 @@ class Checkpointer:
         events.emit("ckpt_promote", world=world,
                     step=int(m.group(1)) if m else None)
 
-    def _save_multihost(self, step, state, rank, world):
+    def _save_multihost(self, step, state, rank, world,
+                        shard_specs=None):
         """Two-phase commit across ``world`` hosts sharing this
         directory.  Each host (including the leader) runs phase 1; the
         leader alone runs phase 2.  Non-leaders return after publishing
@@ -700,7 +789,8 @@ class Checkpointer:
             # every attempt of _save_host_once retracts this rank's own
             # marker before touching data, so the leader can never
             # promote around a host that is still (re)writing
-            self._retry.call(self._save_host_once, stage, rank, state)
+            self._retry.call(self._save_host_once, stage, rank, state,
+                             shard_specs)
             if rank == 0:
                 self._promote(stage, final, world)
                 self._gc_orphans()
@@ -710,7 +800,7 @@ class Checkpointer:
             self._retain()
 
     # -- integrity: verify / quarantine / verified fallback -------------
-    def verify(self, step=None):
+    def verify(self, step=None, all_hosts=False):
         """Public READ-ONLY integrity probe of ``step`` (default:
         latest) — this rank's payload, the same bytes :meth:`restore`
         would load.  -> ``"ok"`` (every byte hashes clean against the
@@ -718,7 +808,14 @@ class Checkpointer:
         — soft, old runs keep restoring).  Raises a typed
         :class:`CheckpointCorrupt` naming each mismatched file.  Never
         mutates the directory: a serving-side watcher probes a live
-        training run's checkpoints with this before every hot swap."""
+        training run's checkpoints with this before every hot swap.
+
+        ``all_hosts=True`` probes EVERY writer's payload, not just this
+        rank's — what a reshard-bound reader (a world-M process facing
+        a world-N step) must use, since a resharding restore will read
+        them all.  The combined status is the weakest across payloads
+        (any ``unverifiable`` payload makes the step ``unverifiable``).
+        """
         import time as _time
 
         from dist_keras_tpu.observability import events
@@ -728,14 +825,21 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         step = int(step)
-        path = self._payload_dir(self._read_path(step))
+        if all_hosts:
+            paths = self.host_payload_paths(step)
+        else:
+            paths = [self._payload_dir(self._read_path(step))]
         t0 = _time.perf_counter()
-        status, problems = verify_manifest(path)
-        if status == "corrupt":
-            events.emit("ckpt_corrupt", step=step,
-                        n_problems=len(problems),
-                        problems=problems[:3])
-            raise CheckpointCorrupt(step, path, problems)
+        status = "ok"
+        for path in paths:
+            got, problems = verify_manifest(path)
+            if got == "corrupt":
+                events.emit("ckpt_corrupt", step=step,
+                            n_problems=len(problems),
+                            problems=problems[:3])
+                raise CheckpointCorrupt(step, path, problems)
+            if got == "unverifiable":
+                status = got
         events.emit("ckpt_verify", step=step, status=status,
                     duration_s=_time.perf_counter() - t0)
         return status
@@ -744,14 +848,26 @@ class Checkpointer:
         """Latest step whose payload verifies (``"ok"`` or legacy
         ``"unverifiable"``), or None.  STRICTLY read-only — corrupt
         steps are skipped, not quarantined (this is the supervisor's
-        restart probe, which may run from a non-writer process)."""
+        restart probe, which may run from a non-writer process).
+
+        A step an elastic restore would RESHARD (written by a
+        different world) is judged on EVERY payload it would read —
+        this rank's clean shard must not advertise a step whose other
+        payloads rotted, or the supervised relaunch would crash-loop
+        against a restore this probe claimed was safe."""
+        rank, world = self._coord_ids()
+        reshard_worlds = _elastic_enabled() and (
+            world == 1 or _two_phase_enabled())
         for step in reversed(self.all_steps()):
             try:
-                status, _problems = verify_manifest(
-                    self._payload_dir(self._read_path(step)))
+                if reshard_worlds and self.saved_world(step) != world:
+                    paths = self.host_payload_paths(step)
+                else:
+                    paths = [self._payload_dir(self._read_path(step))]
+                statuses = [verify_manifest(p)[0] for p in paths]
             except (OSError, RuntimeError):
                 continue  # unreadable layout: as unusable as corrupt
-            if status != "corrupt":
+            if all(s != "corrupt" for s in statuses):
                 return step
         return None
 
@@ -779,7 +895,8 @@ class Checkpointer:
             _fsync_dir(self.directory)
         return True
 
-    def restore(self, step=None, template=None, verify=None):
+    def restore(self, step=None, template=None, verify=None,
+                elastic=None):
         """Restore ``step`` (default: latest). ``template``: a pytree with
         the target structure/dtypes (required by orbax for exact restore).
 
@@ -789,13 +906,58 @@ class Checkpointer:
         restore FALLS BACK to the previous promoted step automatically
         — recovery self-heals instead of exploding mid-restore.  Only
         when no verified step remains does the original
-        :class:`CheckpointCorrupt` propagate."""
+        :class:`CheckpointCorrupt` propagate.
+
+        ``elastic`` (default: ``DK_ELASTIC``, on): when the step was
+        written by a DIFFERENT world size than this process's
+        (``saved_world(step) != world`` — the post-resize relaunch, or
+        a world-1 server loading a pod-written checkpoint), delegate to
+        ``resilience.elastic.reshard_restore``: every source payload
+        verified, sharded leaves gathered by global index and re-split
+        for this (rank, world).  With it off, the pre-elastic
+        semantics return."""
         check = _verify_enabled() if verify is None else bool(verify)
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         step = int(step)
+        use_elastic = (_elastic_enabled() if elastic is None
+                       else bool(elastic))
+        if use_elastic:
+            rank, world = self._coord_ids()
+            # with two-phase opted OUT (world > 1 on per-host LOCAL
+            # dirs) the single-host payload layout says nothing about
+            # the writing world — a mismatch verdict would be noise,
+            # so the elastic detection only applies where the layout
+            # is authoritative (a shared directory, or a world-1
+            # reader of one)
+            while (world == 1 or _two_phase_enabled()) \
+                    and self.saved_world(step) != world:
+                from dist_keras_tpu.resilience import elastic as _el
+
+                try:
+                    return _el.reshard_restore(
+                        self, step=step, template=template,
+                        verify=check, rank=rank, world=world)
+                except CheckpointCorrupt:
+                    # world-1 self-heals like the single-host path —
+                    # fall back to the previous promoted step (no
+                    # quarantine: the reshard path keeps reader
+                    # semantics, and the supervisor's probe skips the
+                    # corrupt step the same way).  A world > 1 elastic
+                    # restore propagates typed for the same reason the
+                    # same-world pod path refuses per-rank fallback:
+                    # ranks choosing different steps would diverge.
+                    if world > 1 or not check:
+                        raise
+                    fallback = [s for s in self.all_steps()
+                                if s < step]
+                    if not fallback:
+                        raise
+                    step = fallback[-1]
+                    # a same-world fallback step re-enters the normal
+                    # verified-restore loop below
         while True:
             if check:
                 try:
@@ -840,6 +1002,12 @@ class Checkpointer:
 
     def _restore_inner(self, step, template):
         path = self._payload_dir(self._read_path(step))
+        return self._restore_payload(path, template, step=step)
+
+    def _restore_payload(self, path, template, step=None):
+        """Load ONE payload directory; -> ``(step, state)``.  The unit
+        the per-rank restore and the elastic gather (which reads every
+        host's payload, each with its own exact-shape template) share."""
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):  # fallback-format checkpoint
             import pickle

@@ -53,6 +53,18 @@ rolling-window
 in-process ``supervise()`` loop uses, one recording per WAVE: past it,
 a typed ``CrashLoop`` with the window's evidence — a flapping host
 never flaps forever.
+
+Round 13 — ELASTIC world resize: a host that never comes back after a
+relaunch wave (repeat evidence: nonzero recorded rc, or heartbeats
+that beat and went dark again in the new session) is DROPPED instead
+of burning the budget forever — the next wave launches the surviving
+host set with ranks re-seated, ``DK_COORD_*`` re-exported and the
+session rotated (``elastic_resize`` event + operator alert).  The
+relaunched workers find ``saved_world != current_world`` at restore
+and reshard through ``resilience.elastic``.  ``DK_ELASTIC`` /
+``DK_ELASTIC_MIN_WORLD`` (or ``supervise={"elastic": ...,
+"min_world": ...}``) govern it; membership still only changes across
+incarnations, never mid-run.
 """
 
 from __future__ import annotations
@@ -197,23 +209,30 @@ class Job:
         # refuses (the operator must opt into automatic relaunches: a
         # relaunch against a half-dead pod is an action, not an
         # observation).
+        # "elastic"/"min_world" default to None = resolve the
+        # DK_ELASTIC / DK_ELASTIC_MIN_WORLD knobs at supervise_run
+        # time (launcher-exported values win, same contract as every
+        # other knob)
         if supervise is None or supervise is False:
             self.supervise = None
         elif isinstance(supervise, dict):
             unknown = set(supervise) - {"max_restarts",
                                         "budget_window_s", "interval_s",
-                                        "grace_s"}
+                                        "grace_s", "elastic",
+                                        "min_world"}
             if unknown:
                 raise ValueError(
                     f"unknown supervise knob(s) {sorted(unknown)}; "
                     "valid: max_restarts, budget_window_s, interval_s, "
-                    "grace_s")
+                    "grace_s, elastic, min_world")
             self.supervise = {
                 "max_restarts": int(supervise.get("max_restarts", 3)),
                 "budget_window_s":
                     float(supervise.get("budget_window_s", 600.0)),
                 "interval_s": float(supervise.get("interval_s", 10.0)),
                 "grace_s": float(supervise.get("grace_s", 30.0)),
+                "elastic": supervise.get("elastic"),
+                "min_world": supervise.get("min_world"),
             }
         else:
             # True -> the default budget; an int names it exactly
@@ -221,7 +240,8 @@ class Job:
                 "max_restarts": (3 if supervise is True
                                  else int(supervise)),
                 "budget_window_s": 600.0,
-                "interval_s": 10.0, "grace_s": 30.0}
+                "interval_s": 10.0, "grace_s": 30.0,
+                "elastic": None, "min_world": None}
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
@@ -600,15 +620,32 @@ class Job:
         VERIFIED committed step (``checkpoint.py`` integrity
         manifests), so a relaunch continues from the agreed chunk.
 
+        ELASTIC (``DK_ELASTIC``, default on; ``supervise={"elastic":
+        ..., "min_world": ...}`` overrides): a host that was dead at
+        the previous wave's trigger and is dead AGAIN after that wave
+        relaunched it — evidence-based: a nonzero recorded rc, or
+        heartbeats that beat and went dark in the new session — never
+        came back, and the next wave launches with the SURVIVING host
+        set: ranks re-seated 0..M-1, ``DK_COORD_WORLD`` re-exported,
+        session rotated, an ``elastic_resize`` event (+ operator
+        alert) attributing the decision.  The relaunched workers see
+        ``saved_world != current_world`` at restore and take the
+        resharding path (``resilience.elastic``).  Never below
+        ``min_world`` (default ``DK_ELASTIC_MIN_WORLD``, 1), and never
+        when EVERY host is a repeat offender — a pod that never comes
+        up at all still dies typed on the budget.  Membership still
+        only changes ACROSS incarnations, never mid-run.
+
         Budget: ``Job(supervise=N)``'s rolling-window
         :class:`~dist_keras_tpu.resilience.supervisor.RestartBudget`,
         one recording per relaunch WAVE (a single failure that
         cascades to whole-pod death is one event, not num_hosts of
-        them).  Past it, a typed ``CrashLoop`` carrying the window's
-        evidence (which ranks, when) — flapping hardware becomes an
-        operator page, not an infinite relaunch loop.  ``max_polls``
-        bounds the loop for tests/one-shot probes; the None default
-        supervises until KeyboardInterrupt.
+        them) — a resize wave records like any other.  Past it, a
+        typed ``CrashLoop`` carrying the window's evidence (which
+        ranks, when) — flapping hardware becomes an operator page, not
+        an infinite relaunch loop.  ``max_polls`` bounds the loop for
+        tests/one-shot probes; the None default supervises until
+        KeyboardInterrupt.
         -> list of ``(dead_ranks, session)`` waves performed."""
         from dist_keras_tpu.observability import events
         from dist_keras_tpu.resilience.supervisor import (
@@ -631,9 +668,19 @@ class Job:
                                self.supervise["budget_window_s"])
         interval_s = self.supervise["interval_s"]
         grace_s = self.supervise["grace_s"]
+        from dist_keras_tpu.resilience import elastic as _elastic
+        from dist_keras_tpu.utils import knobs as _knobs
+
+        elastic_on = (self.supervise.get("elastic")
+                      if self.supervise.get("elastic") is not None
+                      else _knobs.get("DK_ELASTIC"))
+        min_world = (self.supervise.get("min_world")
+                     if self.supervise.get("min_world") is not None
+                     else _knobs.get("DK_ELASTIC_MIN_WORLD"))
         relaunched = []
         session = 0
         last_wave = None  # monotonic t of the last relaunch wave
+        last_wave_dead = set()  # hosts dead at the last wave's trigger
         polls = 0
         try:
             while max_polls is None or polls < max_polls:
@@ -709,6 +756,19 @@ class Job:
                             f"{self.supervise['max_restarts']}) — "
                             f"last: {names}",
                             evidence=budget.evidence)
+                    # the ELASTIC decision: a host that was dead at the
+                    # trigger of the PREVIOUS wave and is dead again
+                    # now — after a whole wave relaunched it (nonzero
+                    # rc or beat-then-went-dark in the NEW session) —
+                    # never came back; the next incarnation launches
+                    # with the surviving host set instead of burning
+                    # the budget against a dead machine
+                    survivors, dropped = (
+                        _elastic.choose_surviving_hosts(
+                            self.hosts, {h for _, h in dead},
+                            last_wave_dead, min_world=min_world)
+                        if elastic_on else (None, ()))
+                    last_wave_dead = {h for _, h in dead}
                     session += 1
                     if out is not None:
                         out(f"[supervise] dead: {names} — relaunching "
@@ -721,9 +781,39 @@ class Job:
                     # collective deadline away from noticing, and two
                     # incarnations must never write the checkpoint
                     # directory concurrently (rc ignored — best-effort
-                    # by design, see stop_host)
+                    # by design, see stop_host).  On a resize wave the
+                    # stop still covers every OLD host, dropped ones
+                    # included.
                     for host in self.hosts:
                         self.stop_host(host)
+                    if survivors is not None:
+                        old_world = self.num_processes
+                        dropped_ranks = [r for r, h in
+                                         enumerate(self.hosts)
+                                         if h in dropped]
+                        # the resize IS this wave: ranks are re-seated
+                        # 0..M-1 over the survivors, DK_COORD_* are
+                        # re-exported by host_env from the updated
+                        # world, and workers detect saved_world !=
+                        # current_world at restore and reshard
+                        self.hosts = list(survivors)
+                        self.num_processes = len(survivors)
+                        if out is not None:
+                            out(f"[supervise] elastic resize: "
+                                f"{old_world} -> {self.num_processes} "
+                                f"hosts (dropped "
+                                f"{', '.join(dropped)}) — they never "
+                                f"came back after a relaunch wave")
+                        events.emit("elastic_resize", session=session,
+                                    old_world=old_world,
+                                    new_world=self.num_processes,
+                                    dropped_ranks=dropped_ranks,
+                                    dropped_hosts=list(dropped))
+                        supervisor_alert(
+                            "elastic_resize", session=session,
+                            old_world=old_world,
+                            new_world=self.num_processes,
+                            dropped_hosts=list(dropped))
                     rc = 0
                     for pid, host in enumerate(self.hosts):
                         rc_host = self.sync_host(host)

@@ -86,6 +86,7 @@ KNOWN_EVENTS = (
     "preempt_signal", "preempt", "preempt_exit",
     "coord", "coord_error", "barrier", "peer_dead",
     "supervisor_restart", "supervisor_giveup",
+    "elastic_resize", "reshard_restore",
     # serving (serving/)
     "serve_enqueue", "serve_batch_flush", "serve_batch_error",
     "serve_predict", "serve_predict_error",

@@ -169,6 +169,9 @@ KNOWN_METRICS = {
     # supervisor
     "supervisor.restarts": "counter",
     "supervisor.giveups": "counter",
+    # elastic resharding restore (resilience/elastic.py)
+    "reshard.restores": "counter",
+    "reshard.bytes": "counter",
     # serving
     "serve.enqueued": "counter",
     "serve.completed": "counter",

@@ -109,6 +109,8 @@ def summarize(events):
     epochs = {}       # rank -> epoch_end count
     signalled = {}    # rank -> signum (preemption attribution)
     dead = []         # peer-dead transitions [(rank reporting, peer)]
+    resizes = []      # elastic world resizes, in timeline order
+    reshards = []     # resharding restores [(rank, step, N -> M)]
     nonfinite = 0
     for ev in events:
         rank = int(ev.get("rank", 0))
@@ -162,6 +164,20 @@ def summarize(events):
                 signalled.setdefault(rank, ev.get("signum"))
         elif kind == "peer_dead":
             dead.append((rank, ev.get("peer")))
+        elif kind == "elastic_resize":
+            resizes.append({
+                "session": ev.get("session"),
+                "old_world": ev.get("old_world"),
+                "new_world": ev.get("new_world"),
+                "dropped_ranks": ev.get("dropped_ranks"),
+                "dropped_hosts": ev.get("dropped_hosts")})
+        elif kind == "reshard_restore":
+            reshards.append({
+                "rank": rank, "step": ev.get("step"),
+                "saved_world": ev.get("saved_world"),
+                "world": ev.get("world"),
+                "n_sharded": ev.get("n_sharded"),
+                "bytes_in": ev.get("bytes_in")})
     # the "agreed save step": under coordinated preemption every rank
     # saves the same step — report it when the saves agree
     agreed = None
@@ -182,6 +198,8 @@ def summarize(events):
         "nonfinite_steps": nonfinite,
         "preempt_signalled": signalled,
         "peer_dead": dead,
+        "elastic_resizes": resizes,
+        "reshard_restores": reshards,
     }
 
 
@@ -367,6 +385,17 @@ def render(directory, last_n=10):
     if s["peer_dead"]:
         lines.append("dead-peer reports: " + ", ".join(
             f"rank {r} saw peer {p} die" for r, p in s["peer_dead"]))
+    for rz in s["elastic_resizes"]:
+        lines.append(
+            f"elastic resize: world {rz['old_world']} -> "
+            f"{rz['new_world']} at session {rz['session']} (dropped "
+            f"ranks {rz['dropped_ranks']}: {rz['dropped_hosts']})")
+    for rs in s["reshard_restores"]:
+        lines.append(
+            f"reshard restore: rank {rs['rank']} loaded step "
+            f"{rs['step']} written by world {rs['saved_world']} as "
+            f"world {rs['world']} ({rs['n_sharded']} sharded leaves, "
+            f"{rs['bytes_in']} bytes gathered)")
     # the tail per host — what each host was doing when the run ended
     by_rank = {}
     for ev in events:

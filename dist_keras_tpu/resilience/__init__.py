@@ -25,6 +25,12 @@ equivalent by design and proves it with deterministic fault injection:
   filesystem rendezvous under ``DK_COORD_DIR``, or trivially local;
   typed :class:`PeerLost` / :class:`BarrierTimeout` instead of hangs,
   heartbeat liveness files for dead-peer attribution.
+- :mod:`~dist_keras_tpu.resilience.elastic` — elastic world resize:
+  a promoted world-N checkpoint re-partitioned onto world M at load
+  time (:func:`reshard_restore` — per-payload manifest verification,
+  gather-by-global-index, deterministic re-split), plus the evidence
+  rule ``Job.supervise_run`` uses to shrink a pod around a host that
+  never came back.
 - :mod:`~dist_keras_tpu.resilience.supervisor` — the auto-resume loop
   (``supervise(fn, checkpointer, ...)``): restore from the latest
   VERIFIED checkpoint on crash or :class:`Preempted`, never retry
@@ -38,6 +44,7 @@ matrix, and the self-healing (verify / quarantine / supervise) layer.
 
 from dist_keras_tpu.resilience import (
     coordination,
+    elastic,
     faults,
     guards,
     preemption,
@@ -57,6 +64,7 @@ from dist_keras_tpu.resilience.faults import (
     fault_point,
     inject,
 )
+from dist_keras_tpu.resilience.elastic import reshard_restore
 from dist_keras_tpu.resilience.guards import NonFiniteLossError
 from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.resilience.retry import RetryPolicy, retry_call
@@ -67,11 +75,11 @@ from dist_keras_tpu.resilience.supervisor import (
 )
 
 __all__ = [
-    "coordination", "faults", "guards", "preemption", "retry",
-    "supervisor",
+    "coordination", "elastic", "faults", "guards", "preemption",
+    "retry", "supervisor",
     "BarrierTimeout", "CoordinatorPoisoned", "CrashLoop",
     "FaultInjected", "FileCoordinator", "PeerLost", "RestartBudget",
     "armed", "fault_point", "get_coordinator", "inject",
     "NonFiniteLossError", "Preempted", "RetryPolicy", "retry_call",
-    "supervise",
+    "reshard_restore", "supervise",
 ]

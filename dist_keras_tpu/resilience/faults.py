@@ -115,6 +115,7 @@ KNOWN_POINTS = (
     "job.rsync", "job.ssh", "job.heartbeat",
     "punchcard.read_manifest", "stream.fetch", "step.loss",
     "serve.enqueue", "serve.predict", "serve.reload",
+    "reshard.load", "reshard.scatter",
 )
 
 

@@ -35,6 +35,12 @@ Failure semantics (the serving third of the resilience story):
   trainer's live directory, which a reader must never do.  A legacy
   pre-manifest checkpoint verifies "unverifiable" and reloads as
   before.
+- A checkpoint written by a DIFFERENT world size than the server's —
+  a world-1 serving host hot-loading a pod-written two-phase step —
+  is an ELASTIC reload: the probe verifies EVERY host payload and the
+  restore re-partitions through ``resilience.elastic.reshard_restore``
+  (sharded leaves gathered by global index, replicated leaves from
+  the leader) instead of failing the per-rank payload lookup.
 """
 
 from __future__ import annotations
@@ -126,8 +132,19 @@ class CheckpointWatcher:
             try:
                 # read-only probe (never quarantines — this process is
                 # a reader of someone else's training directory); "ok"
-                # and the legacy "unverifiable" both proceed to the swap
-                self.checkpointer.verify(cand)
+                # and the legacy "unverifiable" both proceed to the
+                # swap.  A step written by a DIFFERENT world than this
+                # server's (a world-1 server hot-loading a pod-written
+                # checkpoint) is a RESHARD restore — it will read
+                # EVERY host's payload, so the probe must cover them
+                # all, and the restore below re-partitions via
+                # resilience.elastic instead of failing the per-rank
+                # payload lookup.
+                _rank, world = self.checkpointer._coord_ids()
+                if self.checkpointer.saved_world(cand) != world:
+                    self.checkpointer.verify(cand, all_hosts=True)
+                else:
+                    self.checkpointer.verify(cand)
                 step = cand
                 break
             except CheckpointCorrupt as e:

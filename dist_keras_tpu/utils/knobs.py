@@ -101,6 +101,16 @@ _register("DK_CKPT_TWO_PHASE", True, _parse_bool, kind="bool",
           doc="`0` opts a pod with per-host LOCAL checkpoint dirs out "
               "of the shared-fs two-phase commit protocol")
 
+# elastic world resize
+_register("DK_ELASTIC", True, _parse_bool, kind="bool",
+          doc="`0` disables the elastic paths: a world-mismatched "
+              "restore keeps the pre-elastic semantics and "
+              "`supervise_run` never shrinks the pod")
+_register("DK_ELASTIC_MIN_WORLD", 1, int,
+          "the elastic supervisor never resizes below this many "
+          "hosts (a would-be smaller pod dies typed on the restart "
+          "budget instead)")
+
 # fault injection / chaos
 _register("DK_FAULTS", "", str,
           "semicolon-separated fault schedule "

@@ -63,9 +63,23 @@ SIGTERM'd training run from the agreed chunk; and a crash-looping
 callable must die typed (``CrashLoop``) once the restart budget is
 spent.  Per-run verdicts are recorded into the gates JSON.
 
+The ELASTIC gate (``--elastic-only``, this PR) is the world-resize
+acceptance: a 2-process FileCoordinator training loop launched through
+``Job.supervise_run`` over a LOCAL transport shim (ssh/rsync rewritten
+onto per-host directories), with one host SIGKILLing itself
+permanently mid-run after the first promoted two-phase save.  The
+supervisor must relaunch, observe the host never coming back (nonzero
+recorded rc / beat-then-dark heartbeats), resize the pod to ONE host
+inside the restart budget — no ``CrashLoop``, no hang — and the
+world-1 relaunch must reshard-restore the world-2 checkpoint and run
+to completion; the final promoted step must verify and restore
+bit-equal to the reference single-host computation, with the resize
+and reshard attributed in the merged observability report.
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
                         [--serving-only] [--chaos-only]
+                        [--elastic-only]
 """
 
 from __future__ import annotations
@@ -1092,6 +1106,253 @@ def run_chaos_gate(k=8, timeout=150):
     }
 
 
+# The elastic gate's worker entrypoint — shipped as the job directory's
+# main.py and launched by Job.supervise_run over the local transport
+# shim in _ELASTIC_DRIVER.  A deterministic "training" loop: a global
+# float vector sharded over the world along dim 0 (elementwise updates,
+# so shards evolve independently exactly like data-parallel replicas),
+# two-phase saves with shard_specs on the odd units, heartbeats via the
+# FileCoordinator.  Host h1 kills itself with SIGKILL after the step-3
+# promotion and poisons its own host directory, so every relaunch of
+# h1 dies instantly (rc 137 from the launch wrapper) — the "machine is
+# gone for good" the elastic supervisor must resize around.  A resumed
+# incarnation restores the latest verified step; when the saved world
+# differs from DK_COORD_WORLD the restore reshards automatically.
+_ELASTIC_ENTRY = r"""
+import os, signal, sys, time
+
+host = os.path.basename(os.path.dirname(os.path.dirname(os.getcwd())))
+work = os.environ["ELASTIC_GATE_WORK"]
+dead_file = os.path.join(work, "dead_host")
+
+
+def die_if_poisoned():
+    try:
+        with open(dead_file) as f:
+            doomed = f.read().strip()
+    except OSError:
+        return
+    if doomed == host:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+die_if_poisoned()
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.resilience import coordination, elastic
+
+rank = int(os.environ["DK_COORD_RANK"])
+world = int(os.environ["DK_COORD_WORLD"])
+coord = coordination.get_coordinator()
+ck = Checkpointer(os.path.join(work, "ck"), commit_timeout_s=10)
+N, TOTAL = 256, 8
+dims = {"w": 0, "i": None}
+if ck.latest_verified_step() is None:
+    w = elastic.split_leaf(np.arange(N, dtype=np.float64), 0, world,
+                           rank)
+    start = 0
+else:
+    tmpl = {"w": elastic.split_leaf(
+        np.zeros(N, dtype=np.float64), 0, world, rank),
+        "i": np.int64(0)}
+    step, st = ck.restore(template=tmpl)
+    w = np.asarray(st["w"], dtype=np.float64)
+    start = int(st["i"]) + 1
+    print("RESUMED", rank, world, "from", step, flush=True)
+for i in range(start, TOTAL):
+    die_if_poisoned()
+    w = w * 1.01 + i
+    time.sleep(0.1)
+    coord.any_flag(False)
+    if i % 2 == 1:
+        step = coord.agree_min(i)
+        ck.save(step, {"w": w, "i": np.int64(i)}, shard_specs=dims)
+        coord.barrier("save_%d" % i)
+    if host == "h1" and i == 4 and not os.path.exists(dead_file):
+        # the permanent hardware loss: SIGKILL (no cleanup, no typed
+        # exit) + a poison marker so every relaunch dies instantly too
+        with open(dead_file + ".tmp", "w") as f:
+            f.write(host)
+        os.replace(dead_file + ".tmp", dead_file)
+        os.kill(os.getpid(), signal.SIGKILL)
+print("COMPLETED", rank, world, flush=True)
+sys.exit(0)
+"""
+
+# The elastic gate's driver (one subprocess, clean env): builds the
+# job, runs supervise_run against REAL local processes via a transport
+# shim (ssh -> `sh -c` under the host's directory, rsync -> a local
+# copy), then post-checks the verdicts.
+_ELASTIC_DRIVER = r"""
+import os, shutil, subprocess, sys, time
+
+work = sys.argv[1]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ELASTIC_GATE_WORK"] = work
+os.environ["DK_OBS_DIR"] = os.path.join(work, "obs")
+os.environ["DK_COORD_STALE_S"] = "2"
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.launch.job import Job
+from dist_keras_tpu.observability import report as obs_report
+from dist_keras_tpu.resilience.supervisor import CrashLoop
+
+hosts_root = os.path.join(work, "hosts")
+jobdir = os.path.join(work, "jobdir")
+os.makedirs(jobdir, exist_ok=True)
+with open(os.environ["ELASTIC_GATE_ENTRY"], "r") as src, \
+        open(os.path.join(jobdir, "main.py"), "w") as f:
+    f.write(src.read())
+
+failures = []
+
+
+def check(cond, msg):
+    if not cond:
+        failures.append(msg)
+
+
+class LocalJob(Job):
+    # host X's "remote" filesystem is hosts/<X>/; ssh becomes `sh -c`
+    # with that cwd, rsync becomes a local copy — the Job code under
+    # test is byte-identical, only the transport is rewritten
+    def _run(self, cmd, point=None):
+        self.commands.append(cmd)
+        if cmd[0] == "rsync":
+            src, dst = cmd[-2].rstrip("/"), cmd[-1]
+            host, path = dst.split(":", 1)
+            d = os.path.join(hosts_root, host, path.strip("/"))
+            os.makedirs(d, exist_ok=True)
+            shutil.copytree(src, d, dirs_exist_ok=True)
+            return 0
+        if cmd[0] == "ssh":
+            host, shell = cmd[1], cmd[2]
+            hostdir = os.path.join(hosts_root, host)
+            os.makedirs(hostdir, exist_ok=True)
+            return subprocess.call(["sh", "-c", shell], cwd=hostdir)
+        return subprocess.call(cmd)
+
+
+job = LocalJob("s", "job", jobdir, entrypoint="main.py",
+               hosts=["h0", "h1"], remote_root="jobs",
+               coord_dir=os.path.join(work, "coord"),
+               coord_timeout_s=10.0,
+               obs_dir=os.path.join(work, "obs"),
+               supervise={"max_restarts": 4,
+                          "budget_window_s": 600.0,
+                          "interval_s": 0.5, "grace_s": 5.0})
+rc = job.send()
+check(rc == 0, "initial send rc=%d" % rc)
+t0 = time.time()
+try:
+    waves = job.supervise_run(max_polls=360, out=None,
+                              stale_after_s=2.0)
+except CrashLoop as e:
+    print("ELASTIC_BAD crash_loop: %s" % e, flush=True)
+    sys.exit(1)
+wall = time.time() - t0
+
+check(len(waves) >= 2,
+      "expected >= 2 relaunch waves, got %r" % (waves,))
+check(job.num_processes == 1 and job.hosts == ["h0"],
+      "pod did not resize to the surviving host: world=%d hosts=%r"
+      % (job.num_processes, job.hosts))
+
+# reference computation: the global state a single host would have
+w = np.arange(256, dtype=np.float64)
+for i in range(8):
+    w = w * 1.01 + i
+ck = Checkpointer(os.path.join(work, "ck"), rank=0, world=1)
+latest = ck.latest_step()
+check(latest == 7, "latest promoted step %r != 7" % (latest,))
+if latest is not None:
+    status = ck.verify(latest, all_hosts=True)
+    check(status == "ok", "final step verify -> %r" % (status,))
+    step, st = ck.restore(step=latest)
+    check(step == latest, "restore fell back to %r" % (step,))
+    check(np.array_equal(np.asarray(st["w"]), w),
+          "world-1 restore is not bit-equal to the reference")
+
+summary = obs_report.summarize(
+    obs_report.read_events(os.path.join(work, "obs")))
+resizes = summary["elastic_resizes"]
+check(any(r["old_world"] == 2 and r["new_world"] == 1
+          for r in resizes),
+      "merged report attributes no 2->1 elastic resize: %r"
+      % (resizes,))
+check(any(r["saved_world"] == 2 and r["world"] == 1
+          for r in summary["reshard_restores"]),
+      "merged report attributes no 2->1 reshard restore: %r"
+      % (summary["reshard_restores"],))
+
+if failures:
+    print("ELASTIC_BAD " + "; ".join(failures), flush=True)
+    sys.exit(1)
+print("ELASTIC_OK waves=%d wall=%.1fs final_step=%d"
+      % (len(waves), wall, latest), flush=True)
+"""
+
+
+def run_elastic_gate(timeout=300):
+    """-> gate record for the elastic world-resize gate (see the module
+    docstring): permanent single-host loss on a 2-host FileCoordinator
+    run must end in a completed world-1 run with a verified,
+    bit-equal-restorable promoted checkpoint — no CrashLoop, no hang,
+    resize attributed in the merged obs report."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_elastic_gate_")
+    driver = os.path.join(work, "driver.py")
+    entry = os.path.join(work, "entry.py")
+    with open(driver, "w") as f:
+        f.write(_ELASTIC_DRIVER.replace("%REPO%", repr(REPO)))
+    with open(entry, "w") as f:
+        f.write(_ELASTIC_ENTRY.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_CKPT", "DK_ALERT",
+                                     "DK_ELASTIC"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    base_env["ELASTIC_GATE_ENTRY"] = entry
+    t0 = time.time()
+    failures = []
+    verdict = ""
+    p = subprocess.Popen(
+        [sys.executable, driver, os.path.join(work, "run")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=base_env, text=True)
+    try:
+        out = p.communicate(timeout=timeout)[0]
+    except subprocess.TimeoutExpired:
+        p.kill()
+        out = "HANG: " + p.communicate()[0][-500:]
+    for line in out.strip().splitlines():
+        if line.startswith(("ELASTIC_OK", "ELASTIC_BAD")):
+            verdict = line
+    if p.returncode != 0 or not verdict.startswith("ELASTIC_OK"):
+        failures.append(
+            f"driver rc={p.returncode}: "
+            f"{verdict or out[-500:]}")
+    shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "elastic_world_resize",
+        "metric": "shrunk_run_completes_and_restores_bit_equal",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "verdict": verdict,
+        "failures": failures,
+    }
+
+
 def run_serving_gate(timeout=420):
     """-> gate record for the serving subsystem (see _SERVE_WORKER)."""
     import shutil
@@ -1446,6 +1707,12 @@ def main():
                          "seeded randomized-fault 2-process runs + "
                          "corruption quarantine + supervise "
                          "resume/giveup) and print its record")
+    ap.add_argument("--elastic-only", action="store_true",
+                    help="run just the elastic world-resize gate "
+                         "(2-process run, one host SIGKILLed "
+                         "permanently -> supervisor resizes to 1 "
+                         "host, reshard restore bit-equal) and print "
+                         "its record")
     ap.add_argument("--lint-only", action="store_true",
                     help="run just the dklint static-analysis gate "
                          "(python -m dist_keras_tpu.analysis over the "
@@ -1474,6 +1741,11 @@ def main():
         print(json.dumps(chaos_gate, indent=1))
         return 0 if chaos_gate["passed"] else 1
 
+    if args.elastic_only:
+        elastic_gate = run_elastic_gate()
+        print(json.dumps(elastic_gate, indent=1))
+        return 0 if elastic_gate["passed"] else 1
+
     if args.serving_only:
         serve_gate = run_serving_gate()
         print(json.dumps(serve_gate, indent=1))
@@ -1494,6 +1766,7 @@ def main():
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_chaos_gate())
+    res["gates"].append(run_elastic_gate())
     res["gates"].append(run_watchdog_gate())
     res["gates"].append(run_lint_gate())
     import platform

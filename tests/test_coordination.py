@@ -350,9 +350,12 @@ def test_missing_own_payload_in_committed_step_is_an_error(tmp_path):
     """A committed step missing THIS rank's payload is corrupt:
     restoring another host's state (per-host optimizer slots, staleness
     counters) would silently diverge the run.  A rank beyond the
-    writing world (larger-world resume) still reads the leader's
-    replica."""
+    writing world (larger-world resume) takes the elastic resharding
+    path — which convicts the missing payload typed; only the
+    pre-elastic opt-out still reads the leader's replica."""
     import shutil
+
+    from dist_keras_tpu.checkpoint import CheckpointCorrupt
 
     ck1 = _ckptr(tmp_path, rank=1, world=2)
     ck0 = _ckptr(tmp_path, rank=0, world=2)
@@ -365,9 +368,13 @@ def test_missing_own_payload_in_committed_step_is_an_error(tmp_path):
     # rank 0's own payload still restores
     step, got = ck0.restore(template=_state(0, 4))
     assert step == 4
-    # a rank beyond the writing world falls back to the leader replica
+    # a rank beyond the writing world reshards (round 13) — a deleted
+    # payload is typed corrupt there too, never a silent leader copy
     ck5 = _ckptr(tmp_path, rank=5, world=6)
-    step, got = ck5.restore(template=_state(0, 4))
+    with pytest.raises(CheckpointCorrupt, match="host_1"):
+        ck5.restore(template=_state(0, 4))
+    # the pre-elastic leader-replica fallback stays reachable
+    step, got = ck5.restore(template=_state(0, 4), elastic=False)
     assert int(got["r"]) == 0
 
 

@@ -1,0 +1,451 @@
+"""Elastic world resize: resharding restore round trips (save at world
+N, restore at world M), corruption during a reshard, the serving
+watcher's cross-world hot load, and the launcher-side shrink decision.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.checkpoint import CheckpointCorrupt, Checkpointer
+from dist_keras_tpu.resilience import elastic
+
+
+# ---------------------------------------------------------------------
+# fixtures: a global state, its spec pytree, and the per-rank splitter
+# ---------------------------------------------------------------------
+
+def _global_state():
+    """FSDP-shaped state: a sharded weight + its sharded optimizer
+    moment, a replicated bias (too small / indivisible to shard) and a
+    replicated scalar counter."""
+    return {
+        "params": {
+            "w": np.arange(8 * 16, dtype=np.float64).reshape(8, 16),
+            "b": np.array([1.0, 2.0, 3.0]),
+        },
+        "opt": {"mu": np.arange(8 * 16, dtype=np.float64)
+                .reshape(8, 16) * 0.5},
+        "step": np.int64(11),
+    }
+
+
+_DIMS = {"params": {"w": 0, "b": None}, "opt": {"mu": 0}, "step": None}
+
+
+def _local(state, world, rank):
+    return {
+        "params": {
+            "w": elastic.split_leaf(state["params"]["w"], 0, world,
+                                    rank),
+            "b": state["params"]["b"],
+        },
+        "opt": {"mu": elastic.split_leaf(state["opt"]["mu"], 0, world,
+                                         rank)},
+        "step": state["step"],
+    }
+
+
+def _save_world(directory, state, world, specs=_DIMS, step=5):
+    """A world-N two-phase save of ``state``'s per-rank shards: every
+    non-leader publishes its payload + marker first, the leader's save
+    then finds all markers present and promotes."""
+    for rank in list(range(1, world)) + [0]:
+        Checkpointer(directory, rank=rank, world=world,
+                     max_to_keep=10).save(
+            step, _local(state, world, rank), shard_specs=specs)
+
+
+def _assert_tree_equal(got, want):
+    assert np.array_equal(np.asarray(got["params"]["w"]),
+                          np.asarray(want["params"]["w"]))
+    assert np.array_equal(np.asarray(got["params"]["b"]),
+                          np.asarray(want["params"]["b"]))
+    assert np.array_equal(np.asarray(got["opt"]["mu"]),
+                          np.asarray(want["opt"]["mu"]))
+    assert int(got["step"]) == int(want["step"])
+
+
+# ---------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------
+
+def test_split_gather_roundtrip_even_and_uneven():
+    for n, world in [(12, 4), (10, 4), (7, 2), (5, 5)]:
+        leaf = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        shards = [elastic.split_leaf(leaf, 0, world, r)
+                  for r in range(world)]
+        assert np.array_equal(elastic.gather_leaf(shards, 0), leaf)
+    # replicated: gather takes the leader's copy, split is identity
+    leaf = np.arange(6.0)
+    assert np.array_equal(elastic.split_leaf(leaf, None, 4, 2), leaf)
+    assert np.array_equal(
+        elastic.gather_leaf([leaf, leaf * 0 + 9], None), leaf)
+
+
+def test_spec_dims_accepts_partition_specs():
+    from jax.sharding import PartitionSpec as P
+
+    dims = elastic.spec_dims({"w": P(None, "workers"), "b": P(),
+                              "k": 1, "s": None})
+    assert dims == {"w": 1, "b": None, "k": 1, "s": None}
+    with pytest.raises(ValueError, match="more than one dimension"):
+        elastic.spec_dims({"w": P("workers", "model")})
+
+
+def test_split_leaf_rejects_bad_dim():
+    with pytest.raises(ValueError, match="cannot split"):
+        elastic.split_leaf(np.arange(4.0), 1, 2, 0)
+
+
+def test_choose_surviving_hosts_evidence_rule():
+    hosts = ["h0", "h1", "h2"]
+    # no repeat offender -> no resize
+    assert elastic.choose_surviving_hosts(
+        hosts, {"h1"}, set()) == (None, ())
+    # h1 dead at the last wave AND again now -> dropped
+    assert elastic.choose_surviving_hosts(
+        hosts, {"h1"}, {"h1"}) == (["h0", "h2"], ("h1",))
+    # a host dead now but NOT at the last wave survives the drop
+    assert elastic.choose_surviving_hosts(
+        hosts, {"h0", "h1"}, {"h1"}) == (["h0", "h2"], ("h1",))
+    # every host a repeat offender -> giving up is the budget's job
+    assert elastic.choose_surviving_hosts(
+        hosts, set(hosts), set(hosts)) == (None, ())
+    # min_world floor
+    assert elastic.choose_surviving_hosts(
+        hosts, {"h1", "h2"}, {"h1", "h2"}, min_world=2) == (None, ())
+    assert elastic.choose_surviving_hosts(
+        hosts, {"h2"}, {"h2"}, min_world=2) == (["h0", "h1"], ("h2",))
+
+
+# ---------------------------------------------------------------------
+# resharding restore round trips
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,m", [(2, 1), (1, 2), (4, 2), (2, 4)])
+def test_reshard_roundtrip_bit_equal(tmp_path, n, m):
+    g = _global_state()
+    _save_world(str(tmp_path), g, n)
+    for rank in range(m):
+        ck = Checkpointer(str(tmp_path), rank=rank, world=m)
+        step, st = ck.restore(template=_local(g, m, rank))
+        assert step == 5
+        _assert_tree_equal(st, _local(g, m, rank))
+    # the M=1 view IS the single-host reference: a world-1 save of the
+    # same global state restores bit-identically
+    ref_dir = str(tmp_path / "ref")
+    Checkpointer(ref_dir, rank=0, world=1).save(5, _local(g, 1, 0),
+                                                shard_specs=_DIMS)
+    _step, ref = Checkpointer(ref_dir, rank=0, world=1).restore()
+    _step, got = Checkpointer(str(tmp_path), rank=0, world=1).restore()
+    _assert_tree_equal(got, ref)
+
+
+def test_reshard_with_fsdp_partition_specs(tmp_path):
+    """The spec pytree can come straight from ``parallel.fsdp``:
+    ``fsdp_specs`` for params, ``match_specs_for_state`` for the
+    optimizer template — the save records the same dims."""
+    from dist_keras_tpu.parallel.fsdp import (
+        fsdp_specs,
+        match_specs_for_state,
+    )
+
+    import jax
+
+    g = _global_state()
+    pspecs = fsdp_specs(g["params"], axis_size=2, min_shard_elems=8)
+    specs = {"params": pspecs,
+             "opt": match_specs_for_state(g["params"], pspecs,
+                                          g["opt"]),
+             "step": None}
+    dims = elastic.spec_dims(specs)
+    # (8, 16) leaves shard (fsdp picks the LARGEST divisible dim — 1
+    # here), the 3-vector replicates
+    assert dims["params"]["b"] is None
+    assert dims["params"]["w"] == 1
+
+    def local(rank):
+        flat, td = jax.tree_util.tree_flatten_with_path(g)
+        flat_d = jax.tree_util.tree_leaves(
+            dims, is_leaf=lambda x: x is None or isinstance(x, int))
+        return jax.tree_util.tree_unflatten(td, [
+            elastic.split_leaf(leaf, d, 2, rank)
+            for (_p, leaf), d in zip(flat, flat_d)])
+
+    for rank in (1, 0):
+        Checkpointer(str(tmp_path), rank=rank, world=2).save(
+            5, local(rank), shard_specs=specs)
+    step, st = Checkpointer(str(tmp_path), rank=0, world=1).restore()
+    assert step == 5
+    _assert_tree_equal(st, g)
+
+
+def test_reshard_emits_attribution(tmp_path, monkeypatch):
+    from dist_keras_tpu.observability import events, report
+
+    g = _global_state()
+    _save_world(str(tmp_path / "ck"), g, 2)
+    obs = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(obs))
+    events.reset()
+    try:
+        Checkpointer(str(tmp_path / "ck"), rank=0, world=1).restore()
+    finally:
+        events.reset()
+    monkeypatch.delenv("DK_OBS_DIR")
+    s = report.summarize(report.read_events(str(obs)))
+    assert s["reshard_restores"], "no reshard_restore in the report"
+    row = s["reshard_restores"][0]
+    assert row["saved_world"] == 2 and row["world"] == 1
+    assert row["n_sharded"] == 2 and row["bytes_in"] > 0
+    # the uniform restore accounting still fires
+    assert s["checkpoints"]["restored"] == [5]
+    assert "reshard restore" in report.render(str(obs))
+
+
+def test_elastic_opt_out_keeps_pre_elastic_semantics(tmp_path):
+    """``restore(elastic=False)`` (or ``DK_ELASTIC=0``): a world-1
+    reader of a world-2 step reads the leader replica — rank 0's SHARD
+    for sharded leaves, NOT the gathered global state."""
+    g = _global_state()
+    _save_world(str(tmp_path), g, 2)
+    _step, st = Checkpointer(str(tmp_path), rank=0, world=1).restore(
+        elastic=False)
+    assert np.array_equal(np.asarray(st["params"]["w"]),
+                          _local(g, 2, 0)["params"]["w"])
+
+
+def test_saved_world_and_payload_paths(tmp_path):
+    g = _global_state()
+    _save_world(str(tmp_path), g, 2)
+    ck = Checkpointer(str(tmp_path), rank=0, world=2)
+    assert ck.saved_world() == 2
+    paths = ck.host_payload_paths(5)
+    assert [os.path.basename(p) for p in paths] == ["host_0", "host_1"]
+    single = str(tmp_path / "one")
+    Checkpointer(single, rank=0, world=1).save(5, _local(g, 1, 0))
+    one = Checkpointer(single, rank=0, world=1)
+    assert one.saved_world() == 1
+    assert one.host_payload_paths(5) == [
+        os.path.join(single, "step_00000005")]
+    # a payload deleted from within the writing world is typed corrupt
+    import shutil
+
+    shutil.rmtree(paths[1])
+    with pytest.raises(CheckpointCorrupt, match="host_1"):
+        ck.host_payload_paths(5)
+
+
+# ---------------------------------------------------------------------
+# corruption during a reshard
+# ---------------------------------------------------------------------
+
+def _flip_byte(payload_dir):
+    import glob
+
+    files = [f for f in glob.glob(os.path.join(payload_dir, "**"),
+                                  recursive=True)
+             if os.path.isfile(f)
+             and not f.endswith("manifest.json")]
+    tgt = max(files, key=os.path.getsize)
+    with open(tgt, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return tgt
+
+
+def test_corrupt_shard_during_reshard_is_typed(tmp_path):
+    g = _global_state()
+    _save_world(str(tmp_path), g, 2)
+    tgt = _flip_byte(str(tmp_path / "step_00000005" / "host_1"))
+    ck = Checkpointer(str(tmp_path), rank=0, world=1)
+    with pytest.raises(CheckpointCorrupt) as ei:
+        ck.restore()
+    # the error names the rotted file, and points at host_1's payload
+    assert os.path.basename(tgt) in str(ei.value)
+    assert "host_1" in ei.value.path
+    # the reader NEVER quarantines someone else's directory
+    assert not os.path.exists(str(tmp_path / "step_00000005.corrupt"))
+    # verify-all probes the same verdict read-only
+    with pytest.raises(CheckpointCorrupt):
+        ck.verify(5, all_hosts=True)
+
+
+def test_world1_reshard_falls_back_past_corrupt_step(tmp_path):
+    """Supervised elastic recovery must not crash-loop on one rotted
+    payload: the world-1 probe (`latest_verified_step`) judges EVERY
+    payload a reshard would read, and `restore()` falls back to the
+    previous promoted step — without quarantining (reader
+    semantics)."""
+    g = _global_state()
+    older = _global_state()
+    older["step"] = np.int64(3)
+    _save_world(str(tmp_path), older, 2, step=3)
+    _save_world(str(tmp_path), g, 2, step=5)
+    _flip_byte(str(tmp_path / "step_00000005" / "host_1"))
+    ck = Checkpointer(str(tmp_path), rank=0, world=1)
+    # the probe skips step 5: this rank's view of it (host_0) hashes
+    # clean, but the reshard would read host_1 too
+    assert ck.latest_verified_step() == 3
+    step, st = ck.restore()
+    assert step == 3
+    _assert_tree_equal(st, older)
+    assert not os.path.exists(str(tmp_path / "step_00000005.corrupt"))
+    # a stray non-numeric host_* sibling must not crash any reader
+    os.makedirs(str(tmp_path / "step_00000003" / "host_0.tmp"))
+    assert ck.saved_world(3) == 2
+    assert ck.latest_verified_step() == 3
+
+
+def test_reshard_fault_points_fire(tmp_path):
+    from dist_keras_tpu.resilience import faults
+
+    g = _global_state()
+    _save_world(str(tmp_path), g, 2)
+    ck = Checkpointer(str(tmp_path), rank=0, world=1)
+    faults.inject("reshard.load", at=1)
+    try:
+        with pytest.raises(faults.FaultInjected):
+            ck.restore()
+    finally:
+        faults.clear()
+    faults.inject("reshard.scatter", at=0)
+    try:
+        with pytest.raises(faults.FaultInjected):
+            ck.restore()
+    finally:
+        faults.clear()
+    step, _st = ck.restore()  # cleared: the bytes were never touched
+    assert step == 5
+
+
+# ---------------------------------------------------------------------
+# serving: a world-1 watcher hot-loads pod-written checkpoints
+# ---------------------------------------------------------------------
+
+class _Engine:
+    def __init__(self):
+        self.swaps = []
+
+    def set_params(self, state, step=None):
+        self.swaps.append((step, state))
+
+
+def test_watcher_reshards_pod_checkpoint(tmp_path):
+    from dist_keras_tpu.serving.reload import CheckpointWatcher
+
+    g = _global_state()
+    eng = _Engine()
+    watcher = CheckpointWatcher(
+        eng, Checkpointer(str(tmp_path), rank=0, world=1),
+        initial_step=0)
+    _save_world(str(tmp_path), g, 2)
+    assert watcher.poll_once() == 5
+    step, st = eng.swaps[-1]
+    assert step == 5
+    _assert_tree_equal(st, g)  # gathered, not host_0's shard
+
+
+def test_watcher_skips_corrupt_pod_checkpoint(tmp_path):
+    from dist_keras_tpu.serving.reload import CheckpointWatcher
+
+    g = _global_state()
+    eng = _Engine()
+    watcher = CheckpointWatcher(
+        eng, Checkpointer(str(tmp_path), rank=0, world=1),
+        initial_step=0)
+    _save_world(str(tmp_path), g, 2)
+    _flip_byte(str(tmp_path / "step_00000005" / "host_1"))
+    # the only new step is rotted in a payload THIS world-1 server
+    # would need: skipped typed, old params kept, no crash
+    assert watcher.poll_once() is None
+    assert watcher.skipped_corrupt == 1
+    assert eng.swaps == []
+
+
+# ---------------------------------------------------------------------
+# launcher: supervise_run shrinks around a host that never came back
+# ---------------------------------------------------------------------
+
+def _job(tmp_path, **kw):
+    from dist_keras_tpu.launch.job import Job
+
+    jd = tmp_path / "jobdir"
+    jd.mkdir(exist_ok=True)
+    return Job("s", "j1", str(jd), hosts=["h0", "h1"], dry_run=True,
+               coord_dir=str(tmp_path / "coord"), **kw)
+
+
+def test_supervise_run_elastic_shrink_on_file_coordinator(tmp_path):
+    """The shrink scenario end-to-end on FileCoordinator liveness
+    files: conviction 1 (h1 beat-then-dark) -> normal whole-pod wave;
+    conviction 2 (h1 dead AGAIN in the new session, via a nonzero
+    recorded rc) -> elastic resize to the surviving host; the
+    world-1 incarnation's rc 0 then ends supervision."""
+    import time as _time
+
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+
+    job = _job(tmp_path, supervise={"max_restarts": 3, "grace_s": 0.0,
+                                    "interval_s": 0.0})
+    coord = tmp_path / "coord"
+    old = _time.time() - 3600
+    # session 0: h0 beats fresh, h1 beat once and went dark
+    Heartbeat(str(coord), rank=0).beat_once()
+    Heartbeat(str(coord), rank=1).beat_once()
+    os.utime(coord / "hb" / "rank_1", (old, old))
+    # session 1 (after wave 1): h0 healthy, h1 relaunched and died
+    # instantly — nonzero rc recorded by its launch wrapper
+    Heartbeat(str(coord / "1"), rank=0).beat_once()
+    (coord / "1" / "rc").mkdir(parents=True)
+    (coord / "1" / "rc" / "rank_1").write_text("137\n")
+    # session 2 (after the resize wave): the world-1 run completes
+    (coord / "2" / "rc").mkdir(parents=True)
+    (coord / "2" / "rc" / "rank_0").write_text("0\n")
+    waves = job.supervise_run(max_polls=3, out=None, stale_after_s=60)
+    assert waves == [((1,), 1), ((1,), 2)]
+    assert job.hosts == ["h0"] and job.num_processes == 1
+    # the resize wave re-exported the shrunk world under the rotated
+    # session for the surviving host only
+    cmds = [" ".join(c) for c in job.commands]
+    assert any("DK_COORD_WORLD=1" in c and "DK_COORD_SESSION=2" in c
+               and "ssh h0" in c for c in cmds)
+    assert not any("DK_COORD_SESSION=2" in c and "ssh h1" in c
+                   for c in cmds)
+
+
+def test_supervise_run_elastic_respects_min_world(tmp_path):
+    """With min_world above the survivor count, the repeat offender is
+    NOT dropped — the budget's CrashLoop keeps the verdict."""
+    import time as _time
+
+    from dist_keras_tpu.resilience.coordination import Heartbeat
+    from dist_keras_tpu.resilience.supervisor import CrashLoop
+
+    job = _job(tmp_path, supervise={"max_restarts": 1, "grace_s": 0.0,
+                                    "interval_s": 0.0,
+                                    "min_world": 2})
+    coord = tmp_path / "coord"
+    old = _time.time() - 3600
+    Heartbeat(str(coord), rank=0).beat_once()
+    Heartbeat(str(coord), rank=1).beat_once()
+    os.utime(coord / "hb" / "rank_1", (old, old))
+    Heartbeat(str(coord / "1"), rank=0).beat_once()
+    Heartbeat(str(coord / "1"), rank=1).beat_once()
+    os.utime(coord / "1" / "hb" / "rank_1", (old, old))
+    with pytest.raises(CrashLoop):
+        job.supervise_run(max_polls=3, out=None, stale_after_s=60)
+    assert job.hosts == ["h0", "h1"]  # never resized
+
+
+def test_supervise_knob_forms_accept_elastic(tmp_path):
+    j = _job(tmp_path, supervise={"max_restarts": 1, "elastic": False,
+                                  "min_world": 2})
+    assert j.supervise["elastic"] is False
+    assert j.supervise["min_world"] == 2
+    assert _job(tmp_path, supervise=2).supervise["elastic"] is None
+    with pytest.raises(ValueError, match="unknown supervise knob"):
+        _job(tmp_path, supervise={"world": 1})
