@@ -42,18 +42,23 @@ in run order:
    without ``DK_CKPT_VERIFY`` (integrity manifests) + raw SHA-256
    throughput, CPU-pinned subprocess; also run in the
    backend-unresponsive early-exit path, like serving.
-10. Retrace proxy — CPU-measurable attribution rows (jit retrace +
+10. Async checkpoint save — train-loop save-stall seconds vs payload
+   size (64 MB / 256 MB), ``DK_CKPT_ASYNC`` off vs on, with the async
+   step verified + promoted (durability-equal) and the one-pass
+   incremental-hash write wall; CPU-pinned subprocess, also in the
+   backend-unresponsive early-exit path.
+11. Retrace proxy — CPU-measurable attribution rows (jit retrace +
    dispatch counts, H2D/D2H proxy bytes, data/step/comm/ckpt host
    walls) for a streamed windowed trainer, CPU-pinned subprocess; the
    warm-run retrace delta is the "no steady-state retraces" claim.
    Also runs in the backend-unresponsive early-exit path.
-11. Reshard restore — restore wall of one promoted world-2 step
+12. Reshard restore — restore wall of one promoted world-2 step
    same-world vs through the world-1 elastic resharding path (verify
    every manifest, gather by global index, re-split), CPU-pinned
    subprocess; also runs in the backend-unresponsive early-exit path.
-12. Transformer — composite dp x tp x sp step (ring + flash attention);
+13. Transformer — composite dp x tp x sp step (ring + flash attention);
    new capability, no reference counterpart (vs_baseline: null).
-13. Long-context — T=32k causal step, flash kernels + remat="mlp";
+14. Long-context — T=32k causal step, flash kernels + remat="mlp";
    reports hardware MFU (attention-aware) AND param-only MFU.
 
 Baseline denominators (measured in this image with Keras 3 + TF CPU
@@ -797,6 +802,11 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from dist_keras_tpu.checkpoint import Checkpointer, build_manifest
 
+# async pinned OFF: this row measures the SYNCHRONOUS write's
+# DK_CKPT_VERIFY hashing cost (with async on, save() returns after the
+# snapshot and the timer would read enqueue stall, not hash cost —
+# the async pipeline has its own ckpt_async_save row)
+os.environ["DK_CKPT_ASYNC"] = "0"
 mb, reps = int(sys.argv[1]), int(sys.argv[2])
 state = {"w": np.random.default_rng(0).standard_normal(
     mb * 1024 * 1024 // 8)}
@@ -865,8 +875,11 @@ ck_dir = os.path.join(work, "ck")
 for rank in (1, 0):
     local = {"w": elastic.split_leaf(g["w"], 0, 2, rank),
              "i": g["i"]}
+    # wait(): the async default hands the write to a background
+    # thread, and the restores below use FRESH Checkpointer instances
+    # (no join-on-read coverage) — the promotion must be durable first
     Checkpointer(ck_dir, rank=rank, world=2).save(
-        1, local, shard_specs=dims)
+        1, local, shard_specs=dims).wait(timeout_s=60)
 
 same_ck = Checkpointer(ck_dir, rank=0, world=2)
 reshard_ck = Checkpointer(ck_dir, rank=0, world=1)
@@ -907,6 +920,87 @@ def bench_reshard_restore(peak=None, mb=64, reps=5, timeout_s=300):
         "reshard_restore", source=_RESHARD_WORKER,
         args=(mb, reps),
         strip_prefixes=("DK_CKPT", "DK_COORD", "DK_ELASTIC"),
+        timeout_s=timeout_s)
+
+
+# The async-save worker: the train-loop SAVE STALL (wall spent inside
+# Checkpointer.save before control returns to the loop) sync vs async
+# on fixed-size host pytrees, plus the async write wall — and the
+# durability check: after handle.wait() the async step must verify
+# "ok" and be the latest PROMOTED step (async is a latency win, never
+# a durability downgrade).  CPU-pinned subprocess like every
+# host-side row.  argv: mb... reps
+_CKPT_ASYNC_WORKER = r"""
+import json, os, statistics, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+
+sizes, reps = [int(a) for a in sys.argv[1:-1]], int(sys.argv[-1])
+rows = []
+for mb in sizes:
+    # jax-array leaves, like a real training state: the boundary
+    # snapshot of an IMMUTABLE device buffer needs no defensive copy
+    # (host-numpy leaves are copied instead — the aliasing-safety
+    # path tests/test_async_ckpt.py pins)
+    w = jnp.asarray(np.random.default_rng(0).standard_normal(
+        mb * 1024 * 1024 // 8))
+    w.block_until_ready()
+    state = {"w": w, "step": np.int64(1)}
+    work = tempfile.mkdtemp(prefix="dk_bench_async_%d_" % mb)
+
+    def run(async_on, rep):
+        os.environ["DK_CKPT_ASYNC"] = "1" if async_on else "0"
+        d = os.path.join(work, ("a" if async_on else "s") + str(rep))
+        ck = Checkpointer(d, max_to_keep=2)
+        t0 = time.perf_counter()
+        h = ck.save(1, state)
+        stall = time.perf_counter() - t0   # what the loop waited
+        h.wait(timeout_s=180)
+        total = time.perf_counter() - t0   # snapshot + write + commit
+        return stall, total, ck.verify(1), ck.latest_step()
+
+    run(False, "warm")  # discarded: one-time import/fs costs
+    sync_stall, async_stall, async_total = [], [], []
+    all_verified = True   # EVERY async rep must verify + promote
+    for rep in range(reps):
+        s, _t, _v, _l = run(False, rep)
+        sync_stall.append(s)
+        s, t, verified, promoted = run(True, rep)
+        all_verified = all_verified and (
+            verified == "ok" and promoted == 1)
+        async_stall.append(s)
+        async_total.append(t)
+    import shutil
+    shutil.rmtree(work, ignore_errors=True)
+    ss = statistics.median(sync_stall)
+    sa = statistics.median(async_stall)
+    rows.append({
+        "payload_mb": mb,
+        "save_stall_s_sync": round(ss, 4),
+        "save_stall_s_async": round(sa, 4),
+        "stall_reduction_x": round(ss / sa, 1) if sa else None,
+        "write_s_async_total": round(statistics.median(async_total), 4),
+        "async_step_verified": all_verified,
+    })
+print(json.dumps({"reps": reps, "rows": rows}))
+"""
+
+
+def bench_ckpt_async_save(peak=None, sizes=(64, 256), reps=3,
+                          timeout_s=360):
+    """Async-checkpoint-pipeline cost: the train-loop save-stall of
+    ``Checkpointer.save`` with ``DK_CKPT_ASYNC`` off vs on (median-of-
+    ``reps`` per payload size), with the async step verified AND
+    promoted — the tentpole claim is "the loop stops paying for the
+    write without giving up 'promoted ⇒ verified'".  No ``vs_baseline``
+    (the reference has no checkpointing at all)."""
+    return _run_cpu_worker(
+        "ckpt_async_save", source=_CKPT_ASYNC_WORKER,
+        args=(*sizes, reps), strip_prefixes=("DK_CKPT",),
         timeout_s=timeout_s)
 
 
@@ -1070,6 +1164,8 @@ def main():
                                    "serving_cpu_offered_load"),
                                   (bench_ckpt_manifest,
                                    "ckpt_manifest_overhead"),
+                                  (bench_ckpt_async_save,
+                                   "ckpt_async_save"),
                                   (bench_retrace_proxy,
                                    "bench_retrace_proxy"),
                                   (bench_reshard_restore,
@@ -1102,8 +1198,9 @@ def main():
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
                bench_adag_streamed, bench_serving, bench_ckpt_manifest,
-               bench_retrace_proxy, bench_reshard_restore,
-               bench_transformer_tp, bench_long_context):
+               bench_ckpt_async_save, bench_retrace_proxy,
+               bench_reshard_restore, bench_transformer_tp,
+               bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,

@@ -74,6 +74,54 @@ gathered by global index and re-split for the new world, replicated
 leaves take the leader's copy.  Shrink and grow both work; with
 ``DK_ELASTIC=0`` the pre-elastic semantics return (grow reads the
 leader replica, a world-mismatched shrink refuses typed).
+
+This PR — the ASYNC CHECKPOINT PIPELINE.  Every subsystem above sits
+on ``Checkpointer.save``, and until now the training loop paid for the
+whole device→host snapshot → serialize → hash → commit chain inside
+it (the ``ckpt_manifest_overhead`` bench row).  Now, behind
+``DK_CKPT_ASYNC`` (default ON):
+
+- ``save`` snapshots the state to host at the step boundary — the ONLY
+  part the training loop waits for (the snapshot COPIES numpy leaves,
+  so the loop may mutate its buffers while the writer streams) — hands
+  the pytree to a per-``Checkpointer`` background writer thread, and
+  returns an :class:`AsyncSaveHandle`.  ``handle.wait()`` is the
+  durability barrier; the preemption boundary save and the end-of-run
+  drain (``trainers/chunking.py``) wait on it with a bounded deadline
+  so the SIGTERM→exit window still holds.
+- The writer streams bytes out in PER-FILE CHUNKS of large arrays
+  (``DK_CKPT_CHUNK_MB``, default 64; ``0`` = legacy orbax/pickle
+  format) and computes each file's SHA-256 incrementally *as the bytes
+  are written* — the integrity manifest costs one pass, never a second
+  whole-payload read — then runs the SAME atomic / two-phase promote
+  as before.  A promoted step is exactly as durable and as verified as
+  a synchronous one; unpromoted async staging stays invisible to every
+  reader (``latest_step`` / ``latest_verified_step`` / the serving
+  watcher), so the supervisor's restart probe semantics are unchanged.
+- Overlapping save requests COALESCE latest-wins (single-host): at
+  most one write in flight plus one pending — a queued-but-unstarted
+  save superseded by a newer step resolves its handle with a typed
+  :class:`SaveSuperseded` (never an unbounded queue, never a silent
+  drop).  A POD (world > 1 — two-phase or per-host-local alike)
+  applies BACKPRESSURE instead of coalescing (same depth-1 bound; the
+  caller blocks only when two saves are already outstanding): one
+  host skipping a step latest-wins while its peers stage it would
+  strand a two-phase leader's marker wait, and on per-host local dirs
+  it would punch holes in one host's promoted-step sequence so a
+  relaunch silently resumes ranks from different steps.  A background write that fails after its retries resolves the
+  handle with the error, emits ``ckpt_async_error``, and re-raises at
+  the next ``save``/drain — the loop learns its checkpoints stopped
+  landing at the next boundary, like a synchronous failure.
+- Read-side queries on the SAME ``Checkpointer`` instance first join
+  the in-flight write (``restore`` after ``save`` sees the step); the
+  restore path reads chunked and legacy payloads interchangeably, both
+  directions, so old checkpoints keep restoring and new ones restore
+  under ``DK_CKPT_ASYNC=0`` / ``DK_CKPT_CHUNK_MB=0`` too.
+- The caller-side wall lands in the ``ckpt.save_stall_s`` histogram,
+  the writer-side wall in ``ckpt.write_s`` — the split the bench's
+  ``ckpt_async_save`` row reports.  Fault points: ``"ckpt.snapshot"``
+  (caller thread, before the host snapshot) and ``"ckpt.write"``
+  (mid-payload-write on the writer: staging torn, never promoted).
 """
 
 from __future__ import annotations
@@ -81,6 +129,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 
 import jax
 import numpy as np
@@ -98,6 +147,75 @@ except Exception:  # pragma: no cover - orbax is in the image
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 MANIFEST_NAME = "manifest.json"
+CHUNKS_NAME = "chunks.json"
+
+
+class SaveSuperseded(RuntimeError):
+    """A queued-but-unstarted async save was coalesced away by a newer
+    one (latest-wins policy: at most one write in flight plus one
+    pending).  Raised by the superseded :class:`AsyncSaveHandle`'s
+    ``wait()`` — typed, so a caller that insists on THAT step's
+    durability can tell "replaced by something newer" from a failed
+    write."""
+
+
+class AsyncSaveHandle:
+    """The ticket ``Checkpointer.save`` returns.
+
+    ``wait()`` is the durability barrier: it blocks until the save is
+    committed/promoted (-> the step), the write failed (re-raises the
+    writer's typed error), or the save was coalesced away (raises
+    :class:`SaveSuperseded`).  Synchronous saves (``DK_CKPT_ASYNC=0``)
+    return an already-resolved handle, so call sites are uniform."""
+
+    __slots__ = ("step", "_done", "_exc", "_status")
+
+    def __init__(self, step, status="pending"):
+        self.step = int(step)
+        self._done = threading.Event()
+        self._exc = None
+        self._status = status
+        if status != "pending":
+            self._done.set()
+
+    @property
+    def status(self):
+        """"pending" | "committed" | "superseded" | "error"."""
+        return self._status
+
+    def done(self):
+        return self._done.is_set()
+
+    def _resolve(self, status, exc=None):
+        self._exc = exc
+        self._status = status
+        self._done.set()
+
+    def wait(self, timeout_s=None):
+        """Block until resolved; -> the committed step.  Raises the
+        writer's error, :class:`SaveSuperseded` for a coalesced save,
+        or ``TimeoutError`` past ``timeout_s``."""
+        if not self._done.wait(timeout_s):
+            raise TimeoutError(
+                f"async checkpoint save of step {self.step} still in "
+                f"flight after {timeout_s}s")
+        if self._exc is not None:
+            raise self._exc
+        return self.step
+
+
+class _ChunkRef:
+    """Placeholder pickled into a chunked payload's ``small.pkl`` where
+    a chunked array leaf sits in the pytree; ``index`` keys into the
+    ``chunks.json`` leaf table."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index):
+        self.index = int(index)
+
+    def __reduce__(self):
+        return (_ChunkRef, (self.index,))
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -136,6 +254,54 @@ def _elastic_enabled():
     ``resilience.elastic.reshard_restore`` instead of refusing (or
     silently reading the leader replica)."""
     return knobs.get("DK_ELASTIC")
+
+
+def _async_enabled():
+    """``DK_CKPT_ASYNC`` (default on): ``save`` snapshots at the step
+    boundary, hands the write to a background thread and returns an
+    :class:`AsyncSaveHandle`; ``0`` restores the fully synchronous
+    save."""
+    return knobs.get("DK_CKPT_ASYNC")
+
+
+def _chunk_bytes():
+    """``DK_CKPT_CHUNK_MB`` as bytes (default 64 MB).  > 0 selects the
+    streaming chunked payload format (large array leaves written as
+    per-file chunks, hashed as the bytes stream out); 0 keeps the
+    legacy orbax/pickle writer.  Readers understand BOTH formats
+    regardless of this knob."""
+    return int(max(0.0, float(knobs.get("DK_CKPT_CHUNK_MB"))) * 2**20)
+
+
+def _snapshot_host(tree):
+    """Boundary snapshot DECOUPLED from anything the caller can
+    mutate, without paying a copy the backend already paid:
+
+    - host numpy leaves are COPIED (the training loop may keep
+      mutating the very arrays it passed in while the writer streams);
+    - device-backend (TPU/GPU) jax arrays come back from
+      ``np.asarray`` as fresh OWNED host copies — nothing to add;
+    - CPU-backend jax arrays come back as READ-ONLY views of the
+      immutable XLA buffer.  The view's ``.base`` pins the buffer's
+      lifetime, and buffer donation is not implemented on this
+      backend (``tests/test_async_ckpt.py::
+      test_cpu_backend_snapshot_views_survive_donated_chain`` pins
+      that assumption empirically — if a future jax starts reusing
+      donated CPU buffers, that tripwire fails and this function must
+      start copying them), so zero-copy is safe and keeps the async
+      save-stall at its near-zero bench number;
+    - any other leaf whose numpy form is a WRITABLE borrowed view
+      (an exotic duck-typed container) is copied — the writer must
+      never read moving bytes."""
+    def _leaf(x):
+        if isinstance(x, np.ndarray):
+            return np.array(x)
+        arr = np.asarray(x)
+        if arr.flags["WRITEABLE"] and not arr.flags["OWNDATA"]:
+            return np.array(arr)
+        return arr
+
+    return jax.tree.map(_leaf, tree)
 
 
 def _two_phase_enabled():
@@ -195,13 +361,24 @@ def _hash_file(path, chunk=1 << 20):
     return h.hexdigest()
 
 
+def _manifest_from_entries(files):
+    """Manifest dict from ALREADY-HASHED per-file entries
+    (``{rel: {bytes, sha256}}``) — what the streaming chunked writer
+    uses, its hashes computed as the bytes were written (one pass, no
+    whole-payload re-read)."""
+    import hashlib
+
+    tree = hashlib.sha256("\n".join(
+        f"{rel}:{files[rel]['bytes']}:{files[rel]['sha256']}"
+        for rel in sorted(files)).encode()).hexdigest()
+    return {"format": 1, "files": files, "tree_sha256": tree}
+
+
 def build_manifest(root):
     """Integrity manifest of every file under ``root`` (the manifest
     file itself excluded): relative path -> {bytes, sha256}, plus a
     whole-tree digest over the sorted entries so a MISSING or EXTRA
     file is as detectable as a flipped bit."""
-    import hashlib
-
     files = {}
     for dirpath, _dirnames, filenames in os.walk(root):
         for name in filenames:
@@ -211,17 +388,18 @@ def build_manifest(root):
                 continue
             files[rel] = {"bytes": os.path.getsize(full),
                           "sha256": _hash_file(full)}
-    tree = hashlib.sha256("\n".join(
-        f"{rel}:{files[rel]['bytes']}:{files[rel]['sha256']}"
-        for rel in sorted(files)).encode()).hexdigest()
-    return {"format": 1, "files": files, "tree_sha256": tree}
+    return _manifest_from_entries(files)
 
 
-def write_manifest(root):
-    """Write ``build_manifest(root)`` into ``root/manifest.json``
-    atomically (tmp + rename: a kill mid-write leaves no torn manifest
-    that would condemn a healthy payload)."""
-    manifest = build_manifest(root)
+def write_manifest(root, entries=None):
+    """Write the manifest into ``root/manifest.json`` atomically (tmp +
+    rename: a kill mid-write leaves no torn manifest that would condemn
+    a healthy payload).  ``entries`` short-circuits the hashing walk
+    with per-file entries already computed as the bytes were written
+    (the streaming writer's one-pass path); None re-reads the tree
+    (``build_manifest``, the legacy writer's path)."""
+    manifest = (build_manifest(root) if entries is None
+                else _manifest_from_entries(entries))
     path = os.path.join(root, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -357,6 +535,16 @@ class Checkpointer:
         self._retry = retry
         self._inflight = None  # "step_NNNNNNNN" currently being written
         self._ckpt = ocp.StandardCheckpointer() if _HAVE_ORBAX else None
+        # async pipeline: one background writer thread per Checkpointer,
+        # at most one write in flight + one pending (latest wins) —
+        # never an unbounded queue.  All four fields are guarded by the
+        # condition; _async_error is the last background failure not
+        # yet surfaced to the caller (re-raised at the next save/drain).
+        self._async_cv = threading.Condition()
+        self._async_pending = None  # (handle, step, state, specs, rank, world)
+        self._async_active = None   # handle currently being written
+        self._async_thread = None
+        self._async_error = None
 
     def _step_dir(self, step):
         return os.path.join(self.directory, f"step_{step:08d}")
@@ -381,7 +569,16 @@ class Checkpointer:
         was killed mid-swap (``step_N.old`` present, ``step_N`` missing)
         still COUNTS: ``restore`` reads through the retired copy, and
         the writer's next successful save cleans it up.  Orphaned
-        tmp/staging dirs are ignored here for the same reason."""
+        tmp/staging dirs are ignored here for the same reason.
+
+        Same-INSTANCE reads first JOIN any in-flight async write
+        (read-your-writes: ``save`` → ``latest_step`` on one
+        ``Checkpointer`` behaves like the synchronous pipeline, so the
+        call may block for up to the write's duration, bounded by the
+        coordination deadline).  Readers in OTHER processes — the
+        deployed watcher pattern — never block here: unpromoted async
+        staging is invisible to them by construction."""
+        self._join_async()
         steps = set()
         retired = set()
         for name in os.listdir(self.directory):
@@ -395,6 +592,7 @@ class Checkpointer:
     def _read_path(self, step):
         """Where ``step``'s data lives: the committed dir, or the
         retired ``.old`` copy if an overwrite was killed mid-swap."""
+        self._join_async()
         final = self._step_dir(step)
         if not os.path.exists(final) and os.path.exists(final + ".old"):
             return final + ".old"
@@ -544,9 +742,14 @@ class Checkpointer:
         directories), so a serving-side watcher can poll a live
         training run's directory forever without interfering with the
         writer — ``serving.reload.CheckpointWatcher`` probes it with
-        ``timeout_s=0`` (one non-blocking check per loop tick, keeping
-        its own stoppable cadence); pass a real timeout to block.
-        ``step=None`` waits for the first checkpoint ever."""
+        ``timeout_s=0`` (one check per loop tick with no promotion
+        wait, keeping its own stoppable cadence); pass a real timeout
+        to block on a FUTURE promotion.  ``step=None`` waits for the
+        first checkpoint ever.  Async caveat (see :meth:`all_steps`):
+        probing the SAME instance that is mid-way through an async
+        ``save`` first joins that write — the probe then sees the
+        step it was about to miss; a cross-process watcher, the
+        deployed pattern, never blocks on it."""
         import time
 
         deadline = (None if timeout_s is None
@@ -581,21 +784,143 @@ class Checkpointer:
         integrity manifest), which is what lets an ELASTIC restore at a
         different world size gather the shards by global index instead
         of guessing.
+
+        ASYNC (``DK_CKPT_ASYNC``, default on): only the device→host
+        snapshot runs on this thread; the serialize + hash + commit
+        chain is handed to the background writer and the returned
+        :class:`AsyncSaveHandle` is the durability barrier
+        (``handle.wait()``).  A previous background failure re-raises
+        HERE — the training loop learns its checkpoints stopped
+        landing at the next boundary, exactly like a synchronous
+        failure.  Synchronous saves return an already-resolved handle,
+        so call sites are uniform.  Either way the caller-blocked wall
+        lands in the ``ckpt.save_stall_s`` histogram.
         """
         import time as _time
 
-        from dist_keras_tpu.observability import events
+        from dist_keras_tpu.observability import events, metrics
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        t0 = _time.perf_counter()
+        use_async = _async_enabled()
+        # the caller-thread instant before the host snapshot — with an
+        # injected kill here nothing was staged, nothing can promote
+        fault_point("ckpt.snapshot")
+        step = int(step)
+        rank, world = self._coord_ids()
+        if not use_async:
+            state = _to_host(state)
+            # drain any in-flight async write first (the knob re-reads
+            # per call, so async->sync can flip mid-process): two
+            # _save_sync bodies on one instance would clobber the
+            # shared _inflight marker and let the writer's orphan
+            # sweep eat this caller's live staging.  A stored
+            # background failure surfaces here too, like the async
+            # branch.
+            from dist_keras_tpu.resilience.coordination import (
+                default_timeout_s,
+            )
+
+            self.wait_until_finished(timeout_s=default_timeout_s())
+            self._save_sync(step, state, rank, world, shard_specs)
+            metrics.histogram("ckpt.save_stall_s").observe(
+                _time.perf_counter() - t0)
+            return AsyncSaveHandle(step, status="committed")
+        self._raise_async_error()
+        handle = AsyncSaveHandle(step)
+        deadline = None
+        if world > 1:
+            # ONE shared deadline for the whole backpressure wait: the
+            # pre-snapshot slot wait and the publish loop below must
+            # together never exceed a single DK_COORD_TIMEOUT_S — the
+            # SIGTERM→exit window is sized to one deadline
+            from dist_keras_tpu.resilience.coordination import (
+                default_timeout_s,
+            )
+
+            deadline = _time.monotonic() + default_timeout_s()
+            # secure the bounded queue slot BEFORE the snapshot: a
+            # backpressured pod save blocks the training thread, so
+            # the state cannot move during the wait — snapshotting
+            # first would pin a THIRD copy of a multi-GB state in
+            # host memory for up to the whole deadline (the publish
+            # block below re-checks the slot, so this is purely the
+            # memory-bound optimization, not the correctness gate)
+            with self._async_cv:
+                if self._async_pending is not None:
+                    self._async_cv.wait_for(
+                        lambda: self._async_pending is None,
+                        timeout=max(0.0,
+                                    deadline - _time.monotonic()))
+        state = _snapshot_host(state)
+        with self._async_cv:
+            while self._async_pending is not None:
+                if world > 1:
+                    # a POD must never coalesce, two-phase OR opted
+                    # out: under two-phase, one host skipping step S
+                    # latest-wins while its peers stage it would
+                    # strand the leader's marker wait for the whole
+                    # deadline and convict a healthy pod; under
+                    # DK_CKPT_TWO_PHASE=0 (per-host local dirs),
+                    # per-host coalescing would punch HOLES in one
+                    # host's promoted-step sequence and a relaunch
+                    # would silently resume ranks from different
+                    # steps.  Backpressure instead: the queue stays
+                    # bounded at one in flight + one pending, and the
+                    # caller blocks only when two saves are already
+                    # outstanding (lockstep plans keep this symmetric
+                    # across hosts).
+                    # the REMAINDER of the one shared deadline armed
+                    # before the snapshot — never a second full wait
+                    if not self._async_cv.wait_for(
+                            lambda: self._async_pending is None,
+                            timeout=max(0.0,
+                                        deadline - _time.monotonic())):
+                        raise TimeoutError(
+                            "async checkpoint queue full: the "
+                            f"pending save of step "
+                            f"{self._async_pending[0].step} never "
+                            "started within the coordination deadline")
+                else:
+                    # single-host latest-wins coalescing: the queued-
+                    # but-unstarted save resolves typed instead of
+                    # queueing unboundedly
+                    old = self._async_pending[0]
+                    old._resolve("superseded", SaveSuperseded(
+                        f"async save of step {old.step} was "
+                        f"superseded by step {step} before its write "
+                        "began (latest-wins coalescing)"))
+                    events.emit("ckpt_async_coalesced", step=old.step,
+                                by=step)
+                    self._async_pending = None  # slot taken over
+            self._async_pending = (handle, step, state, shard_specs,
+                                   rank, world)
+            self._ensure_writer()
+            self._async_cv.notify_all()
+        stall = _time.perf_counter() - t0
+        metrics.histogram("ckpt.save_stall_s").observe(stall)
+        events.emit("ckpt_async_enqueue", step=step, stall_s=stall)
+        return handle
+
+    def _save_sync(self, step, state, rank, world, shard_specs=None):
+        """The serialize → hash → commit chain on an already-host
+        ``state`` — the body both the synchronous path and the async
+        writer thread run.  Emits ``ckpt_save`` (completed saves only)
+        and observes the writer-side wall into ``ckpt.write_s``."""
+        import time as _time
+
+        from dist_keras_tpu.observability import events, metrics
         from dist_keras_tpu.observability.spans import span
 
         t0 = _time.perf_counter()
-        state = _to_host(state)
-        rank, world = self._coord_ids()
         if world > 1 and _two_phase_enabled():
             with span("ckpt.save", step=step):
                 self._save_multihost(step, state, rank, world,
                                      shard_specs)
+            dt = _time.perf_counter() - t0
+            metrics.histogram("ckpt.write_s").observe(dt)
             events.emit("ckpt_save", step=step, world=world,
-                        duration_s=_time.perf_counter() - t0)
+                        duration_s=dt)
             return
         final = self._step_dir(step)
         tmp = final + ".tmp"
@@ -608,17 +933,150 @@ class Checkpointer:
         finally:
             self._inflight = None
         self._retain()
-        events.emit("ckpt_save", step=step, world=world,
-                    duration_s=_time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        metrics.histogram("ckpt.write_s").observe(dt)
+        events.emit("ckpt_save", step=step, world=world, duration_s=dt)
+
+    # -- async writer machinery -----------------------------------------
+    def _ensure_writer(self):
+        """Start the background writer (caller holds ``_async_cv``)."""
+        t = self._async_thread
+        if t is not None and t.is_alive():
+            return
+        self._async_thread = threading.Thread(
+            target=self._writer_loop, daemon=True, name="dk-ckpt-writer")
+        self._async_thread.start()
+
+    def _writer_loop(self):
+        from dist_keras_tpu.observability import events
+
+        while True:
+            with self._async_cv:
+                while self._async_pending is None:
+                    if not self._async_cv.wait(timeout=60.0):
+                        if self._async_pending is None:
+                            # idle for a minute: retire (restarted on
+                            # demand by _ensure_writer) — a process
+                            # that churns Checkpointer instances must
+                            # not accumulate parked threads forever
+                            self._async_thread = None
+                            return
+                job = self._async_pending
+                self._async_pending = None
+                self._async_active = job[0]
+                # wake a pod-mode save() backpressured on the pending
+                # slot (promotion may take the whole marker wait)
+                self._async_cv.notify_all()
+            handle, step, state, specs, rank, world = job
+            exc = None
+            completed = False
+            try:
+                self._save_sync(step, state, rank, world, specs)
+                completed = True
+            # dklint: ignore[broad-except] the handle carries the typed
+            # error to whoever waits; _async_error re-raises it at the
+            # next save/drain — a writer-thread death would hang both
+            except Exception as e:
+                exc = e
+            finally:
+                # ALWAYS resolve the handle and clear the active slot,
+                # even when something beyond Exception escapes
+                # (KeyboardInterrupt / interpreter teardown on the
+                # daemon): a reader joining on this condition must
+                # never hang forever, and the handle must never claim
+                # durability for a write that did not finish
+                if not completed and exc is None:
+                    exc = RuntimeError(
+                        "async checkpoint writer interrupted before "
+                        f"completing step {step}")
+                handle._resolve("committed" if exc is None else "error",
+                                exc)
+                with self._async_cv:
+                    if exc is not None:
+                        self._async_error = exc
+                    self._async_active = None
+                    self._async_cv.notify_all()
+            if exc is not None:
+                events.emit("ckpt_async_error", step=step,
+                            error=type(exc).__name__,
+                            detail=str(exc)[:200])
+            # drop the job locals BEFORE parking on the condition: the
+            # snapshot (potentially GBs of copied host arrays) must not
+            # stay pinned by an idle thread's frame until the next save
+            job = handle = state = specs = exc = None
+
+    def _raise_async_error(self):
+        with self._async_cv:
+            e, self._async_error = self._async_error, None
+        if e is not None:
+            raise e
+
+    def _join_async(self):
+        """Wait (bounded by the coordination deadline) for this
+        instance's async queue to drain — the read-side barrier that
+        makes ``save`` → ``restore`` on one ``Checkpointer`` behave
+        like the synchronous pipeline.  Bounded, not forever: a
+        wedged writer must degrade a read query to "shows what is
+        promoted so far" (its read-only truth), never hang it.  A
+        no-op from the writer thread itself (``_retain``/
+        ``_gc_orphans`` read the directory mid-write) and for OTHER
+        processes' writers (their staging is invisible until promoted
+        anyway — cross-process pollers never block here)."""
+        from dist_keras_tpu.resilience.coordination import (
+            default_timeout_s,
+        )
+
+        # one drain implementation: wait_until_finished already
+        # no-ops from the writer thread / with no writer started
+        self.wait_until_finished(timeout_s=default_timeout_s(),
+                                 raise_errors=False)
+
+    def wait_until_finished(self, timeout_s=None, raise_errors=True):
+        """Drain the async pipeline; -> True once idle.  With
+        ``raise_errors`` (default) an un-surfaced background failure
+        re-raises here and a deadline overrun raises ``TimeoutError``;
+        ``raise_errors=False`` returns False at the deadline and leaves
+        any stored error for the next boundary to surface."""
+        if (self._async_thread is None
+                or threading.current_thread() is self._async_thread):
+            drained = True
+        else:
+            with self._async_cv:
+                drained = self._async_cv.wait_for(
+                    lambda: self._async_pending is None
+                    and self._async_active is None, timeout=timeout_s)
+        if not drained and raise_errors:
+            # a stored earlier failure must not be MASKED by the
+            # deadline: chain it so the root cause (say, the ENOSPC
+            # that broke save A before save B wedged) survives into
+            # the one traceback the run ends with
+            with self._async_cv:
+                cause = self._async_error
+                self._async_error = None
+            raise TimeoutError(
+                f"async checkpoint writer for {self.directory} still "
+                f"busy after {timeout_s}s") from cause
+        if drained and raise_errors:
+            self._raise_async_error()
+        return drained
 
     def _write_payload(self, tmp, state, shard_specs=None):
         """Write ``state`` into the staging dir ``tmp`` (clean-slate) and
-        fsync it — the write half of every commit protocol here."""
+        fsync it — the write half of every commit protocol here.
+        ``DK_CKPT_CHUNK_MB`` > 0 (the default) selects the streaming
+        chunked format; 0 keeps the legacy orbax/pickle writer."""
         import shutil
 
         # a retry (or an earlier interrupted save of the same step)
         # may have left the path behind — start clean
         shutil.rmtree(tmp, ignore_errors=True)
+        chunk_bytes = _chunk_bytes()
+        if chunk_bytes > 0:
+            self._write_payload_chunked(tmp, state, shard_specs,
+                                        chunk_bytes)
+            return
+        from dist_keras_tpu.resilience.faults import fault_point
+
         if self._ckpt is not None:
             self._ckpt.save(tmp, state, force=True)
             self._ckpt.wait_until_finished()
@@ -631,6 +1089,9 @@ class Checkpointer:
 
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        # payload written, manifest not yet: a kill here leaves torn
+        # STAGING — invisible to every reader, never promoted
+        fault_point("ckpt.write")
         if shard_specs is not None:
             # the self-describing half of the elastic contract: the
             # meta rides INSIDE the payload, BEFORE the manifest, so
@@ -646,6 +1107,96 @@ class Checkpointer:
             # the manifest with it — exactly as durable, never a
             # separate commit instant
             write_manifest(tmp)
+        if self.fsync:
+            _fsync_tree(tmp)
+
+    def _write_payload_chunked(self, tmp, state, shard_specs,
+                               chunk_bytes):
+        """The streaming chunked writer: array leaves >= ``chunk_bytes``
+        stream out as raw per-file chunks (``chunk_{leaf}.{k}``), the
+        remaining pytree pickles into ``small.pkl`` with
+        :class:`_ChunkRef` placeholders, and ``chunks.json`` records
+        each chunked leaf's dtype/shape/file list.  EVERY file's
+        SHA-256 is computed as its bytes are written, so the integrity
+        manifest is assembled in the same single pass — no second
+        whole-payload read.  The ``"ckpt.write"`` fault point fires
+        once, mid-stream (after the first file, before the manifest):
+        the staging dir is torn there, and must never promote."""
+        import hashlib
+        import pickle
+
+        from dist_keras_tpu.resilience.faults import fault_point
+
+        os.makedirs(tmp, exist_ok=True)
+        entries = {}  # rel -> {bytes, sha256}, built as bytes land
+        # DK_CKPT_VERIFY=0 opts out of the HASHING too, not just the
+        # manifest file — the knob's documented contract is "skip the
+        # integrity cost", and hashing multi-GB chunks to discard the
+        # digests would silently keep charging it
+        hashing = _verify_enabled()
+
+        def _put(rel, blocks):
+            h = hashlib.sha256() if hashing else None
+            n = 0
+            with open(os.path.join(tmp, rel), "wb") as f:
+                for block in blocks:
+                    f.write(block)
+                    if h is not None:
+                        h.update(block)
+                    n += len(block)
+            if h is not None:
+                entries[rel] = {"bytes": n, "sha256": h.hexdigest()}
+
+        flat, treedef = jax.tree_util.tree_flatten(state)
+        skeleton, leaf_meta = [], []
+        fired = False
+        for i, leaf in enumerate(flat):
+            arr = leaf if isinstance(leaf, np.ndarray) else None
+            if (arr is None or arr.dtype == object
+                    or arr.nbytes < chunk_bytes):
+                skeleton.append(leaf)
+                continue
+            arr = np.ascontiguousarray(arr)
+            # raw byte view via uint8 (NOT memoryview.cast("B"):
+            # ml_dtypes like bfloat16 are not buffer-exportable and
+            # the cast raises ValueError — the uint8 reinterpret view
+            # works for every numpy-registered dtype)
+            mv = arr.reshape(-1).view(np.uint8)
+            files = []
+            for k in range((arr.nbytes + chunk_bytes - 1) // chunk_bytes):
+                rel = f"chunk_{i:04d}.{k:05d}"
+                _put(rel, (mv[k * chunk_bytes:(k + 1) * chunk_bytes],))
+                files.append(rel)
+                if not fired:
+                    fired = True  # mid-stream: some chunks staged only
+                    fault_point("ckpt.write")
+            skeleton.append(_ChunkRef(i))
+            # str(dtype), not dtype.str: ml_dtypes render as opaque
+            # '<V2' under .str but round-trip by NAME ('bfloat16' ->
+            # np.dtype works once jax/ml_dtypes is imported, which
+            # this module guarantees); standard dtypes keep their
+            # explicit byte order ('>f8' stays '>f8')
+            leaf_meta.append({"index": i, "dtype": str(arr.dtype),
+                              "shape": [int(s) for s in arr.shape],
+                              "files": files})
+        _put("small.pkl", (pickle.dumps(
+            jax.tree_util.tree_unflatten(treedef, skeleton),
+            protocol=pickle.HIGHEST_PROTOCOL),))
+        if not fired:
+            fault_point("ckpt.write")  # all leaves small: same instant
+        _put(CHUNKS_NAME, (json.dumps(
+            {"format": 1, "chunk_bytes": int(chunk_bytes),
+             "leaves": leaf_meta}, sort_keys=True).encode(),))
+        if shard_specs is not None:
+            from dist_keras_tpu.resilience import elastic as _elastic
+
+            rank, world = self._coord_ids()
+            meta = _elastic.build_shard_meta(state, shard_specs, world,
+                                             rank)
+            _put(_elastic.SHARD_META_NAME,
+                 (json.dumps(meta, indent=0, sort_keys=True).encode(),))
+        if hashing:
+            write_manifest(tmp, entries=entries)
         if self.fsync:
             _fsync_tree(tmp)
 
@@ -1007,7 +1558,13 @@ class Checkpointer:
     def _restore_payload(self, path, template, step=None):
         """Load ONE payload directory; -> ``(step, state)``.  The unit
         the per-rank restore and the elastic gather (which reads every
-        host's payload, each with its own exact-shape template) share."""
+        host's payload, each with its own exact-shape template) share.
+        Understands EVERY payload format regardless of the current
+        knobs — chunked (``chunks.json``), pickle fallback
+        (``state.pkl``) and orbax — so chunked and un-chunked
+        checkpoints restore interchangeably in both directions."""
+        if os.path.exists(os.path.join(path, CHUNKS_NAME)):
+            return step, self._restore_chunked(path)
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):  # fallback-format checkpoint
             import pickle
@@ -1024,6 +1581,91 @@ class Checkpointer:
         raise RuntimeError(
             "orbax unavailable and no fallback state.pkl checkpoint at "
             f"{path}")
+
+    def _restore_chunked(self, path):
+        """Read a chunked payload: unpickle the skeleton, then fill
+        each chunked leaf's preallocated buffer from its chunk files in
+        order.  Self-describing (dtype + shape recorded at save time),
+        so no template is needed — the caller's template still pins
+        dtypes downstream where the contract asks for it.  A missing
+        or short chunk is a typed :class:`CheckpointCorrupt` (the
+        verified-restore path convicts it via the manifest first; this
+        guards the ``verify=False`` escape hatch)."""
+        import pickle
+
+        try:
+            with open(os.path.join(path, CHUNKS_NAME)) as f:
+                meta = json.load(f)
+            with open(os.path.join(path, "small.pkl"), "rb") as f:
+                skeleton = pickle.load(f)
+            cb = int(meta.get("chunk_bytes") or 0)
+            # resolve every leaf's plan INSIDE the guard: valid JSON
+            # of the wrong SHAPE (rotted key names, a leaf missing
+            # 'files', a garbage dtype string) must convict typed too,
+            # not leak a bare KeyError/TypeError past verify=False.
+            # np.dtype parses both the name form this writer records
+            # ('bfloat16', 'float64') and explicit byte-order codes
+            # ('<f8').
+            plans = [(int(m["index"]),
+                      np.dtype(str(m["dtype"])),
+                      tuple(int(s) for s in m["shape"]),
+                      [str(r) for r in m["files"]])
+                     for m in meta["leaves"]]
+        except (OSError, EOFError, ValueError, KeyError, TypeError,
+                pickle.UnpicklingError, AttributeError) as e:
+            # the format's own metadata rotted: as damning as a bad
+            # chunk, and it must stay TYPED even under verify=False
+            # (the escape hatch this guard exists for)
+            raise CheckpointCorrupt(None, path, [
+                f"chunked payload metadata unreadable: "
+                f"{type(e).__name__}: {e}"])
+        arrays = {}
+        for index, dtype, shape, files in plans:
+            # the uint8 reinterpret view fills dtypes that are not
+            # buffer-exportable (ml_dtypes) too
+            arr = np.empty(shape, dtype=dtype)
+            mv = arr.reshape(-1).view(np.uint8)
+            off = 0
+            for j, rel in enumerate(files):
+                # each chunk's exact span is known from the recorded
+                # chunk size: a short OR padded chunk file is convicted
+                # here, never silently shifted into the next chunk's
+                # bytes
+                want = (min(cb, arr.nbytes - j * cb) if cb
+                        else arr.nbytes)
+                full = os.path.join(path, rel)
+                try:
+                    with open(full, "rb") as f:
+                        got = f.readinto(mv[off:off + want])
+                        extra = f.read(1)
+                except OSError as e:
+                    raise CheckpointCorrupt(None, path, [
+                        f"{rel}: chunk unreadable "
+                        f"({type(e).__name__}: {e})"])
+                if got != want or extra:
+                    raise CheckpointCorrupt(None, path, [
+                        f"{rel}: {got}{'+' if extra else ''} bytes, "
+                        f"leaf chunk wants exactly {want}"])
+                off += got
+            if off != arr.nbytes:
+                raise CheckpointCorrupt(None, path, [
+                    f"chunk_{index:04d}: {off} bytes read, leaf "
+                    f"wants {arr.nbytes}"])
+            arrays[index] = arr
+        def _fill(x):
+            if not isinstance(x, _ChunkRef):
+                return x
+            if x.index not in arrays:
+                # well-formed chunks.json whose leaves table lost the
+                # entry small.pkl still references: typed, like every
+                # other metadata rot
+                raise CheckpointCorrupt(None, path, [
+                    f"{CHUNKS_NAME}: no leaf entry for chunk index "
+                    f"{x.index} referenced by small.pkl"])
+            return arrays[x.index]
+
+        return jax.tree_util.tree_map(
+            _fill, skeleton, is_leaf=lambda x: isinstance(x, _ChunkRef))
 
     def _retain(self):
         # leader-only on a pod, like _gc_orphans: retention deletes are

@@ -81,6 +81,7 @@ KNOWN_EVENTS = (
     # checkpointing (checkpoint.py)
     "ckpt_save", "ckpt_promote", "ckpt_restore", "ckpt_verify",
     "ckpt_corrupt",
+    "ckpt_async_enqueue", "ckpt_async_coalesced", "ckpt_async_error",
     # resilience seams
     "retry", "retry_exhausted", "fault", "nonfinite", "nan_halt",
     "preempt_signal", "preempt", "preempt_exit",

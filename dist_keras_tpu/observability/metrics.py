@@ -160,6 +160,10 @@ class Histogram:
 KNOWN_METRICS = {
     # training
     "train.nonfinite_steps": "counter",
+    # checkpointing (checkpoint.py): what the training loop actually
+    # waited vs what the (possibly background) writer spent
+    "ckpt.save_stall_s": "histogram",
+    "ckpt.write_s": "histogram",
     # streaming data plane
     "stream.batches": "counter",
     "stream.rows": "counter",

@@ -50,6 +50,15 @@ Fault points: ``"reshard.load"`` fires per source payload read and
 ``"reshard.scatter"`` before the re-split, so a death at either instant
 is deterministically testable (both are in ``faults.KNOWN_POINTS`` for
 chaos mode).
+
+Chunked payloads (``DK_CKPT_CHUNK_MB``, the async-pipeline streaming
+format) reshard like any other: the pre-gather verification walks the
+manifest's per-chunk entries (one SHA-256 per ``chunk_NNNN.KKKKK``
+file, computed as the bytes streamed out at save time), and
+``Checkpointer._restore_payload`` reassembles each host's chunked
+leaves before the gather — the format is self-describing, so the
+per-host ``shard_meta.json`` local shapes and the chunk tables always
+agree by construction.
 """
 
 from __future__ import annotations

@@ -111,6 +111,7 @@ _env_loaded = False
 # are the ones passed to fault_point at each call site.)
 KNOWN_POINTS = (
     "checkpoint.save", "checkpoint.commit", "coord.commit",
+    "ckpt.snapshot", "ckpt.write",
     "coord.flag", "coord.agree", "coord.barrier",
     "job.rsync", "job.ssh", "job.heartbeat",
     "punchcard.read_manifest", "stream.fetch", "step.loss",

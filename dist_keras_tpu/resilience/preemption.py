@@ -29,6 +29,15 @@ Multi-host scope: the flag set here is PER-PROCESS; the dispatch loop
 step (``agree_min``), committing it two-phase (``checkpoint.py``), and
 barriering before any host raises :class:`Preempted` — so the scheduler
 restarts the whole pod against one fully-committed checkpoint.
+
+Async checkpointing (``DK_CKPT_ASYNC``, default on) does not stretch
+the SIGTERM→exit window's durability contract: the boundary save the
+loop makes on a delivered signal WAITS on its
+``checkpoint.AsyncSaveHandle`` (and any in-flight cadence save it
+coalesced behind) with a deadline bounded by ``DK_COORD_TIMEOUT_S``
+before :class:`Preempted` is raised — ``saved_step`` keeps naming a
+step that is promoted (and, single-host, verified) on disk, never one
+still streaming out of the background writer.
 """
 
 from __future__ import annotations

@@ -41,6 +41,15 @@ Launcher-side, ``launch.Job(supervise=...)`` reuses
 ``dead_hosts()``) over the existing rsync/ssh retry surfaces, rotating
 ``DK_COORD_SESSION`` per incarnation so the FileCoordinator rendezvous
 never mixes two attempts' markers.
+
+Async checkpointing changes NOTHING here by design:
+``latest_verified_step`` only ever sees PROMOTED steps, and an async
+save's staging directory is invisible until the same atomic/two-phase
+promote the synchronous pipeline ran — so the restart probe can never
+hand a relaunch a step that is still streaming out of a dead
+incarnation's background writer.  (The dispatch loop additionally
+drains its writer before every exit, so an in-process relaunch never
+races a zombie write in the same directory.)
 """
 
 from __future__ import annotations

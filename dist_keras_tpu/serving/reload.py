@@ -41,6 +41,14 @@ Failure semantics (the serving third of the resilience story):
   restore re-partitions through ``resilience.elastic.reshard_restore``
   (sharded leaves gathered by global index, replicated leaves from
   the leader) instead of failing the per-rank payload lookup.
+- Async/chunked saves (``DK_CKPT_ASYNC`` / ``DK_CKPT_CHUNK_MB`` on the
+  TRAINER side) need nothing special here: the watcher still only ever
+  sees PROMOTED steps (async staging is invisible until the same
+  atomic promote), and the verify probe walks the manifest's
+  PER-CHUNK entries — each ``chunk_NNNN.KKKKK`` file of a large leaf
+  hashes independently, so a single rotted chunk convicts the step
+  exactly like a rotted whole-payload file, and the restore reads the
+  chunked format transparently.
 """
 
 from __future__ import annotations

@@ -116,6 +116,23 @@ class Trainer:
         timeseries.maybe_start_sampler()
 
     def record_training_end(self):
+        # drain any in-flight async checkpoint save FIRST (bounded by
+        # the coordination deadline) so a completed train() leaves its
+        # last cadence save promoted — but NEVER raise from here: this
+        # is the post-mortem stamper, and it runs on the preempt/halt
+        # path right before `raise Preempted` (a raise would replace
+        # the typed 128+signum exit and skip the report.txt that must
+        # exist precisely for abnormal exits).  The CLEAN path
+        # surfaces deferred background-save errors one line later, in
+        # ChunkRunner.run's post-record drain.
+        ckptr = getattr(self, "_checkpointer", None)
+        if ckptr is not None:
+            from dist_keras_tpu.resilience.coordination import (
+                default_timeout_s,
+            )
+
+            ckptr.wait_until_finished(timeout_s=default_timeout_s(),
+                                      raise_errors=False)
         self._t_stop = time.time()
         from dist_keras_tpu.observability import events, timeseries
 
@@ -332,19 +349,10 @@ class Trainer:
         self._last_ckpt_epoch = int(step)
         return int(step), state
 
-    def _maybe_checkpoint(self, epochs_done, state_fn):
-        """Save every ``checkpoint_every`` epochs since the last save (and
-        at the final epoch) — counted from the resume point, so resuming at
-        a non-multiple epoch never skips chunk-boundary saves.  ``state_fn``
-        is lazy so the host transfer only happens on save."""
-        ckptr = self._checkpointer_or_none()
-        if ckptr is None:
-            return
-        last = getattr(self, "_last_ckpt_epoch", 0)
-        cadence = self.checkpoint_every or self.num_epoch
-        if epochs_done - last >= cadence or epochs_done >= self.num_epoch:
-            ckptr.save(epochs_done, state_fn())
-            self._last_ckpt_epoch = epochs_done
+    # (the cadence-save implementation lives in ChunkRunner._maybe_ckpt
+    # — every trainer routes through the chunked dispatch loop, which
+    # also owns the async-handle drain/error-surfacing scaffolding; a
+    # second copy here would silently drop AsyncSaveHandles)
 
     def _emit_epoch_end(self, epochs_done, losses, seconds, samples):
         """Record structured per-epoch metrics; fire callbacks.

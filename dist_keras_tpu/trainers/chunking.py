@@ -265,8 +265,30 @@ class ChunkRunner:
 
     def _maybe_ckpt(self, units_done, state_fn):
         if self._ckpt_due(units_done):
+            # async (DK_CKPT_ASYNC, default): only the host snapshot
+            # runs here.  The returned handle is deliberately dropped —
+            # the preempt boundary and the end-of-run drain wait
+            # through Checkpointer.wait_until_finished, which covers
+            # whatever write is in flight regardless of coalescing.
+            # A PREVIOUS background failure re-raises out of save() at
+            # this boundary — like a synchronous failure one cadence
+            # late.  Rapid boundary saves coalesce latest-wins inside
+            # the Checkpointer (bounded: one in flight + one pending).
             self.tr._checkpointer_or_none().save(units_done, state_fn())
             self.tr._last_ckpt_epoch = units_done
+
+    def _drain_saves(self, raise_errors, timeout_s=None):
+        """Wait (bounded by the coordination deadline, or an explicit
+        ``timeout_s``) for any in-flight async save — a run leaving the
+        dispatch loop must never leave a background writer racing a
+        relaunched incarnation in the same checkpoint directory."""
+        ckptr = self.tr._checkpointer_or_none()
+        if ckptr is None:
+            return
+        ckptr.wait_until_finished(
+            timeout_s=(coordination.default_timeout_s()
+                       if timeout_s is None else timeout_s),
+            raise_errors=raise_errors)
 
     def _preempt_save(self, units_done, state_fn, world=1):
         """Boundary checkpoint on a delivered SIGTERM/SIGINT — saved
@@ -290,9 +312,19 @@ class ChunkRunner:
         ckptr = self.tr._checkpointer_or_none()
         if ckptr is None:
             return None
+        # the async pipeline must not stretch the SIGTERM→exit window:
+        # the boundary save (and any still-in-flight cadence save it
+        # coalesced behind) is waited on with a bounded deadline —
+        # Preempted is only raised once the bytes are promoted, so
+        # saved_step stays a checked claim under DK_CKPT_ASYNC too
+        deadline = coordination.default_timeout_s()
         if getattr(self.tr, "_last_ckpt_epoch", None) != units_done:
-            ckptr.save(units_done, state_fn())
+            handle = ckptr.save(units_done, state_fn())
             self.tr._last_ckpt_epoch = units_done
+            handle.wait(timeout_s=deadline)
+        else:
+            # a cadence save of this exact unit may still be in flight
+            ckptr.wait_until_finished(timeout_s=deadline)
         if world == 1:
             ckptr.verify(units_done)
         return units_done
@@ -513,6 +545,24 @@ class ChunkRunner:
                             acc_dt, acc_samples)
                     break
                 t_mark = time.time()
+        # dklint: ignore[broad-except] re-raised immediately — this arm
+        # only drains the async writer on the UNWIND path (bounded,
+        # no-raise, so the original exception is never masked); the
+        # clean path drains exactly once inside record_training_end
+        # below (a double drain would double the worst-case stall on a
+        # wedged writer).  An unwinding run must not leave a background
+        # writer racing a relaunched incarnation in the same directory.
+        except BaseException as e:
+            # a TimeoutError unwinding here means a handle wait ALREADY
+            # burned one full deadline against this same wedged writer
+            # (_preempt_save) — paying a second would double the
+            # SIGTERM→exit stall the preemption contract bounds; a
+            # zero-timeout probe keeps the no-zombie intent for the
+            # wedged case without the second wait
+            self._drain_saves(
+                raise_errors=False,
+                timeout_s=0 if isinstance(e, TimeoutError) else None)
+            raise
         finally:
             # exception-safe (a raising user callback must not leave
             # the feed pinning the host epoch tensors)
@@ -521,4 +571,15 @@ class ChunkRunner:
             if installed:
                 preemption.restore()
         tr.record_training_end()
+        # the CLEAN-path error surface: record_training_end already
+        # drained (no-raise — it also runs right before `raise
+        # Preempted` — and it paid the one bounded deadline).  This
+        # zero-timeout probe only CLASSIFIES that outcome: it raises
+        # the deferred background-save error, or TimeoutError for a
+        # writer still wedged past the deadline, without waiting a
+        # second one.  A completed run must fail exactly like a
+        # synchronous save raising at the last boundary.
+        ckptr = tr._checkpointer_or_none()
+        if ckptr is not None:
+            ckptr.wait_until_finished(timeout_s=0, raise_errors=True)
         return all_losses

@@ -100,6 +100,17 @@ _register("DK_CKPT_VERIFY", True, _parse_bool, kind="bool",
 _register("DK_CKPT_TWO_PHASE", True, _parse_bool, kind="bool",
           doc="`0` opts a pod with per-host LOCAL checkpoint dirs out "
               "of the shared-fs two-phase commit protocol")
+_register("DK_CKPT_ASYNC", True, _parse_bool, kind="bool",
+          doc="`0` makes `Checkpointer.save` fully synchronous again; "
+              "default: snapshot at the step boundary, then serialize "
+              "+ hash + commit on a background writer thread — the "
+              "returned handle's `wait()` is the durability barrier")
+_register("DK_CKPT_CHUNK_MB", 64.0, float, kind="MB",
+          doc="streaming-writer chunk size: array leaves at least "
+              "this large are written as per-file chunks whose "
+              "SHA-256 is computed as the bytes stream out (one "
+              "pass); `0` falls back to the legacy un-chunked "
+              "orbax/pickle payload format")
 
 # elastic world resize
 _register("DK_ELASTIC", True, _parse_bool, kind="bool",

@@ -123,7 +123,10 @@ for i in range(6):
     sig = preemption.requested()
     if coord.any_flag(sig is not None):
         step = coord.agree_min(units)
-        ckptr.save(step, {"units": np.int64(step)})
+        # wait(): the async default's durability barrier — this worker
+        # raises Preempted right after, and the PREEMPTED claim (like
+        # the trainers' preempt path) must sit on a PROMOTED step
+        ckptr.save(step, {"units": np.int64(step)}).wait(timeout_s=30)
         coord.barrier("preempt_exit")
         print("PREEMPTED", rank, "step", step, flush=True)
         raise Preempted(signal.SIGTERM, saved_step=step)
@@ -250,7 +253,10 @@ for i in range(6):
     sig = preemption.requested()
     if coord.any_flag(sig is not None):
         step = coord.agree_min(units)
-        ckptr.save(step, {"units": np.int64(step)})
+        # wait(): the async default's durability barrier — this worker
+        # raises Preempted right after, and the PREEMPTED claim (like
+        # the trainers' preempt path) must sit on a PROMOTED step
+        ckptr.save(step, {"units": np.int64(step)}).wait(timeout_s=30)
         coord.barrier("preempt_exit")
         print("PREEMPTED", rank, "step", step, flush=True)
         raise Preempted(signal.SIGTERM, saved_step=step)
@@ -458,7 +464,11 @@ for i in range(8):
     if i % 2 == 1:                   # the checkpoint cadence
         step = coord.agree_min(i)
         state = {"w": w.copy(), "i": np.int64(i)}
-        ckptr.save(step, state)
+        # DK_CKPT_ASYNC=1 (pinned by the parent): wait() is the
+        # durability barrier — a SAVED line must still name a step
+        # that is PROMOTED, and an injected mid-async-write kill
+        # (ckpt.write / ckpt.snapshot) surfaces typed right here
+        ckptr.save(step, state).wait(timeout_s=30)
         if rank == 0:
             print("SAVED", step,
                   hashlib.sha256(state["w"].tobytes()).hexdigest(),
@@ -549,9 +559,11 @@ if mode == "corrupt":
     ck = Checkpointer(os.path.join(work, "ck"), rank=0, world=1,
                       max_to_keep=10)
     w1 = np.arange(128, dtype=np.float64)
-    ck.save(1, {"w": w1})
-    ck.save(2, {"w": w1 * 3})
-    ck.save(3, {"w": w1 * 7})
+    # waited: this scenario flips bytes on disk right after saving,
+    # and unwaited async saves would coalesce steps away latest-wins
+    ck.save(1, {"w": w1}).wait(timeout_s=30)
+    ck.save(2, {"w": w1 * 3}).wait(timeout_s=30)
+    ck.save(3, {"w": w1 * 7}).wait(timeout_s=30)
     bad = []
     # (a) bit-flipped payload on the latest step
     flip_byte(os.path.join(work, "ck", "step_00000003"))
@@ -954,6 +966,11 @@ def run_watchdog_gate(timeout=300):
 
 # typed terminal states a chaos worker may die in (matched against the
 # traceback tail): anything else is an UNTYPED death and fails the gate
+# (deliberately NOT "TimeoutError" — a handle wait expiring on these
+# tiny writes IS a hang — and NOT "SaveSuperseded": the chaos workers
+# wait every save and run as a world-2 pod where saves BACKPRESSURE,
+# so either surfacing can only be a pipeline regression; whitelisting
+# them would let exactly those bugs read as typed deaths and pass)
 _CHAOS_TYPED = ("FaultInjected", "PeerLost", "BarrierTimeout",
                 "OSError", "CoordinatorPoisoned", "CheckpointCorrupt",
                 "CrashLoop", "COMPLETED")
@@ -1018,8 +1035,13 @@ def run_chaos_gate(k=8, timeout=150):
             for rank in (0, 1):
                 env = dict(base_env)
                 # per-rank seeds: failures land asymmetrically, like
-                # real hardware — and every schedule replays exactly
+                # real hardware — and every schedule replays exactly.
+                # Async checkpointing pinned ON: the seeded kills must
+                # cover the background-writer instants (ckpt.write /
+                # ckpt.snapshot) with the same invariant — a promoted
+                # step always verifies + restores bit-equal
                 env["DK_FAULTS_SEED"] = str(1000 + seed * 2 + rank)
+                env["DK_CKPT_ASYNC"] = "1"
                 procs.append(subprocess.Popen(
                     [sys.executable, chaos_script, str(rank),
                      coord_dir, ck_dir],
@@ -1168,7 +1190,12 @@ for i in range(start, TOTAL):
     coord.any_flag(False)
     if i % 2 == 1:
         step = coord.agree_min(i)
-        ck.save(step, {"w": w, "i": np.int64(i)}, shard_specs=dims)
+        # wait(): the async default hands the write to a background
+        # thread, and this bespoke loop exits right after the last
+        # boundary — the barrier (and the final sys.exit) must sit on
+        # a PROMOTED step, like the trainers' end-of-run drain
+        ck.save(step, {"w": w, "i": np.int64(i)},
+                shard_specs=dims).wait(timeout_s=30)
         coord.barrier("save_%d" % i)
     if host == "h1" and i == 4 and not os.path.exists(dead_file):
         # the permanent hardware loss: SIGKILL (no cleanup, no typed

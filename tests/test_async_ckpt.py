@@ -1,0 +1,715 @@
+"""Async checkpoint pipeline (ISSUE 10): chunked streaming payloads
+with one-pass incremental hashing, non-blocking saves behind
+``DK_CKPT_ASYNC``, latest-wins coalescing, bounded boundary waits, and
+back-compat restore of un-chunked checkpoints in both directions.
+
+The durability invariant under test everywhere: *promoted ⇒ verified*,
+unchanged from the synchronous pipeline — an async save that dies
+mid-write leaves only invisible staging, never a torn promoted step.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.checkpoint import (
+    CHUNKS_NAME,
+    MANIFEST_NAME,
+    AsyncSaveHandle,
+    CheckpointCorrupt,
+    Checkpointer,
+    SaveSuperseded,
+    verify_manifest,
+)
+from dist_keras_tpu.resilience import FaultInjected, faults, preemption
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    preemption.clear()
+    yield
+    faults.clear()
+    preemption.clear()
+    preemption.restore()
+
+
+def _state(scale=1.0, n=2 ** 16):
+    return {"w": np.arange(n, dtype=np.float64) * scale,
+            "b": np.ones(4, dtype=np.float32),
+            "step": np.int64(3)}
+
+
+def _chunked(monkeypatch, mb="0.25"):
+    """Small chunks so the test states actually shard into files."""
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", mb)
+
+
+# ---------------------------------------------------------------------
+# the chunked payload format
+# ---------------------------------------------------------------------
+
+def test_chunked_save_round_trips_bit_equal(tmp_path, monkeypatch):
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    s = _state()
+    ck.save(1, s).wait()
+    names = sorted(os.listdir(os.path.join(str(tmp_path),
+                                           "step_00000001")))
+    # the 512 KB leaf sharded into 0.25 MB chunk files, small leaves
+    # pickled, everything signed by the manifest
+    assert CHUNKS_NAME in names and "small.pkl" in names
+    assert MANIFEST_NAME in names
+    chunks = [n for n in names if n.startswith("chunk_")]
+    assert len(chunks) == 2
+    step, got = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+    np.testing.assert_array_equal(got["b"], s["b"])
+    assert got["b"].dtype == np.float32 and int(got["step"]) == 3
+
+
+def test_manifest_is_one_pass_and_covers_every_chunk(tmp_path,
+                                                     monkeypatch):
+    """The streaming writer's manifest (hashes computed as bytes were
+    written) must be byte-for-byte what a re-hashing walk computes —
+    and carry one entry per chunk file."""
+    from dist_keras_tpu.checkpoint import build_manifest
+
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = os.path.join(str(tmp_path), "step_00000001")
+    with open(os.path.join(payload, MANIFEST_NAME)) as f:
+        written = json.load(f)
+    rebuilt = build_manifest(payload)
+    assert written == rebuilt
+    assert any(rel.startswith("chunk_") for rel in written["files"])
+
+
+def test_single_rotted_chunk_convicts_the_step(tmp_path, monkeypatch):
+    """Per-chunk manifest entries: flipping ONE chunk file's byte is a
+    typed CheckpointCorrupt naming that chunk — what the serving
+    watcher's verify probe and the reshard pre-gather check read."""
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = tmp_path / "step_00000001"
+    tgt = sorted(p for p in payload.iterdir()
+                 if p.name.startswith("chunk_"))[1]
+    raw = bytearray(tgt.read_bytes())
+    raw[7] ^= 0xFF
+    tgt.write_bytes(bytes(raw))
+    with pytest.raises(CheckpointCorrupt) as ei:
+        ck.verify(1)
+    assert tgt.name in "; ".join(ei.value.problems)
+
+
+def test_chunked_to_unchunked_and_back_compat_both_directions(
+        tmp_path, monkeypatch):
+    """A chunked checkpoint restores with chunking/async OFF, and a
+    legacy (un-chunked) checkpoint restores with them ON — the reader
+    understands every format regardless of the current knobs."""
+    s = _state()
+    # chunked+async write...
+    _chunked(monkeypatch)
+    Checkpointer(str(tmp_path / "a")).save(1, s).wait()
+    # ...read back fully legacy-configured
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    step, got = Checkpointer(str(tmp_path / "a")).restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+    # legacy (orbax-or-pickle) write...
+    Checkpointer(str(tmp_path / "b")).save(2, s, ).wait()
+    assert not os.path.exists(
+        str(tmp_path / "b" / "step_00000002" / CHUNKS_NAME))
+    # ...read back with the async/chunked pipeline ON
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "64")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "1")
+    step, got = Checkpointer(str(tmp_path / "b")).restore(template=s)
+    assert step == 2
+    np.testing.assert_array_equal(got["w"], s["w"])
+
+
+def test_rotted_chunk_metadata_is_typed_even_with_verify_off(
+        tmp_path, monkeypatch):
+    """A missing small.pkl / torn chunks.json must convict TYPED under
+    verify=False too — callers of the escape hatch branch on
+    CheckpointCorrupt, never on raw FileNotFoundError/UnpicklingError."""
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = tmp_path / "step_00000001"
+    (payload / "small.pkl").write_bytes(b"not a pickle")
+    with pytest.raises(CheckpointCorrupt, match="metadata unreadable"):
+        ck.restore(step=1, verify=False)
+    os.remove(payload / "small.pkl")
+    with pytest.raises(CheckpointCorrupt, match="metadata unreadable"):
+        ck.restore(step=1, verify=False)
+    # a PADDED chunk (extra trailing bytes) is convicted, not silently
+    # truncated into the neighbouring chunk's span
+    ck.save(2, _state()).wait()
+    p2 = tmp_path / "step_00000002"
+    tgt = sorted(p for p in p2.iterdir()
+                 if p.name.startswith("chunk_"))[0]
+    tgt.write_bytes(tgt.read_bytes() + b"xx")
+    with pytest.raises(CheckpointCorrupt):
+        ck.restore(step=2, verify=False)
+
+
+def test_truncated_chunk_is_typed_even_with_verify_off(tmp_path,
+                                                       monkeypatch):
+    """The verify=False escape hatch must still die TYPED on a short
+    chunk, not hand back a silently-wrong array."""
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = tmp_path / "step_00000001"
+    tgt = sorted(p for p in payload.iterdir()
+                 if p.name.startswith("chunk_"))[0]
+    tgt.write_bytes(tgt.read_bytes()[:-16])
+    with pytest.raises(CheckpointCorrupt):
+        ck.restore(step=1, verify=False)
+
+
+def test_verify_optout_skips_chunked_hashing_entirely(tmp_path,
+                                                      monkeypatch):
+    """DK_CKPT_VERIFY=0 must skip the HASHING, not just the manifest
+    file — hashing multi-GB chunks to discard the digests would keep
+    charging the integrity cost the knob documents as opted out."""
+    import hashlib
+
+    _chunked(monkeypatch)
+    monkeypatch.setenv("DK_CKPT_VERIFY", "0")
+    real = hashlib.sha256
+
+    def boom(*a, **k):
+        raise AssertionError("hashed despite DK_CKPT_VERIFY=0")
+
+    monkeypatch.setattr(hashlib, "sha256", boom)
+    ck = Checkpointer(str(tmp_path))
+    s = _state()
+    ck.save(1, s).wait(timeout_s=30)
+    monkeypatch.setattr(hashlib, "sha256", real)
+    assert not os.path.exists(
+        str(tmp_path / "step_00000001" / MANIFEST_NAME))
+    assert ck.verify(1) == "unverifiable"
+    step, got = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+
+
+def test_chunked_save_handles_bfloat16_leaves(tmp_path, monkeypatch):
+    """ml_dtypes leaves (bfloat16 — the framework's default compute
+    dtype) are not buffer-exportable: the chunked writer must stream
+    them via a uint8 reinterpret view and record the dtype by NAME
+    (``dtype.str`` renders them as opaque ``<V2``), and the reader
+    must hand back real bfloat16, not void bytes."""
+    import ml_dtypes
+
+    _chunked(monkeypatch, mb="0.001")
+    ck = Checkpointer(str(tmp_path))
+    w = np.arange(4096, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    ck.save(1, {"w": w, "f": np.float64(2.5)}).wait(timeout_s=30)
+    assert ck.verify(1) == "ok"
+    with open(tmp_path / "step_00000001" / CHUNKS_NAME) as f:
+        meta = json.load(f)
+    assert meta["leaves"][0]["dtype"] == "bfloat16"
+    step, got = ck.restore()
+    assert step == 1
+    assert got["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        got["w"].astype(np.float32), w.astype(np.float32))
+
+
+def test_cpu_backend_snapshot_views_survive_donated_chain(tmp_path):
+    """The tripwire behind _snapshot_host's zero-copy rule for jax
+    CPU arrays: buffer donation must NOT reuse a donated CPU buffer
+    while a read-only numpy view of it is alive.  If a future jax
+    starts doing that, this fails — and _snapshot_host must begin
+    copying non-owned read-only views too (at the cost of the
+    near-zero async save stall the bench row reports)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(1 << 14, dtype=jnp.float32)
+    x.block_until_ready()
+    ck = Checkpointer(str(tmp_path))
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    want = np.array(x)
+    h = ck.save(1, {"w": x})   # snapshot holds a read-only view of x
+    step = jax.jit(lambda a: a * 2.0 + 1.0, donate_argnums=0)
+    y = step(x)                # donates x's buffer mid-"write"
+    for _ in range(4):
+        y = step(y)
+    y.block_until_ready()
+    gate.set()
+    h.wait(timeout_s=30)
+    _, got = ck.restore()
+    np.testing.assert_array_equal(got["w"], want)
+
+
+# ---------------------------------------------------------------------
+# async save semantics
+# ---------------------------------------------------------------------
+
+def test_async_save_returns_pending_handle_then_promotes(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    h = ck.save(1, _state())
+    assert isinstance(h, AsyncSaveHandle)
+    assert h.wait(timeout_s=30) == 1
+    assert h.status == "committed" and h.done()
+    assert ck.verify(1) == "ok"
+
+
+def test_sync_mode_returns_resolved_handle(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    ck = Checkpointer(str(tmp_path))
+    h = ck.save(1, _state())
+    assert h.done() and h.status == "committed"
+    assert h.wait(timeout_s=0) == 1
+    assert ck.latest_step() == 1
+
+
+def test_read_queries_join_the_inflight_write(tmp_path):
+    """save -> immediate read on the SAME Checkpointer behaves like the
+    synchronous pipeline (the read side joins the writer)."""
+    ck = Checkpointer(str(tmp_path))
+    s = _state()
+    ck.save(1, s)
+    assert ck.latest_step() == 1          # no sleep, no wait()
+    step, got = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+    assert ck.latest_verified_step() == 1
+
+
+def test_rapid_saves_coalesce_latest_wins_with_typed_handle(tmp_path):
+    """Unwaited back-to-back saves: at most one in flight + one
+    pending; a superseded pending save resolves with the typed
+    SaveSuperseded, and the LAST save always lands."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=10)
+    # hold the writer on the first save so the queue actually forms
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    h1 = ck.save(1, _state(1.0))
+    time.sleep(0.05)        # let the writer pick up save 1
+    h2 = ck.save(2, _state(2.0))   # pending
+    h3 = ck.save(3, _state(3.0))   # supersedes 2
+    gate.set()
+    assert h1.wait(timeout_s=30) == 1
+    assert h3.wait(timeout_s=30) == 3
+    with pytest.raises(SaveSuperseded):
+        h2.wait(timeout_s=30)
+    assert h2.status == "superseded"
+    assert ck.all_steps() == [1, 3]   # 2 never even staged
+
+
+def test_background_write_failure_is_typed_on_handle_and_next_save(
+        tmp_path):
+    """A mid-async-write fault resolves the handle with the error and
+    re-raises at the NEXT save — the loop learns its checkpoints
+    stopped landing at the next boundary, never silently."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1.0)).wait()
+    faults.inject("ckpt.write", at=0, times=1)
+    h = ck.save(2, _state(2.0))
+    with pytest.raises(FaultInjected):
+        h.wait(timeout_s=30)
+    assert h.status == "error"
+    # no torn promoted step; the previous step still restores
+    assert ck.all_steps() == [1]
+    assert ck.restore()[0] == 1
+    # the stored error surfaces at the next boundary save, once
+    with pytest.raises(FaultInjected):
+        ck.save(3, _state(3.0))
+    assert ck.save(3, _state(3.0)).wait(timeout_s=30) == 3
+
+
+def test_crash_mid_async_write_never_leaves_torn_promoted_step(
+        tmp_path, monkeypatch):
+    """The chaos invariant, deterministically: kill the writer between
+    the first chunk file and the manifest — staging is torn, but NO
+    promoted step exists, latest stays put and verifies."""
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1.0)).wait()
+    faults.inject("ckpt.write", at=0, times=99)
+    with pytest.raises(FaultInjected):
+        ck.save(2, _state(2.0)).wait(timeout_s=30)
+    names = os.listdir(str(tmp_path))
+    assert "step_00000002" not in names
+    assert any(n.startswith("step_00000002") for n in names)  # staging
+    ck2 = Checkpointer(str(tmp_path))  # "restarted process"
+    assert ck2.latest_verified_step() == 1
+    assert ck2.verify(1) == "ok"
+    step, got = ck2.restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], _state(1.0)["w"])
+
+
+def test_ckpt_snapshot_fault_fires_on_caller_thread(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    faults.inject("ckpt.snapshot", at=0, times=1)
+    with pytest.raises(FaultInjected):
+        ck.save(1, _state())   # raises from save() itself, no handle
+    assert ck.all_steps() == []
+
+
+def test_wait_deadline_is_a_typed_timeout(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    h = ck.save(1, _state())
+    with pytest.raises(TimeoutError):
+        h.wait(timeout_s=0.05)
+    assert ck.wait_until_finished(timeout_s=0.05,
+                                  raise_errors=False) is False
+    gate.set()
+    assert h.wait(timeout_s=30) == 1
+    assert ck.wait_until_finished(timeout_s=30) is True
+
+
+def test_snapshot_decouples_from_caller_mutations(tmp_path):
+    """The boundary snapshot COPIES host-numpy leaves: mutating the
+    array after save() returns must not tear the written bytes."""
+    ck = Checkpointer(str(tmp_path))
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    w = np.arange(1024, dtype=np.float64)
+    want = w.copy()
+    h = ck.save(1, {"w": w})
+    w[:] = -1.0          # the training loop moves on and mutates
+    gate.set()
+    h.wait(timeout_s=30)
+    _, got = ck.restore()
+    np.testing.assert_array_equal(got["w"], want)
+
+
+def test_save_stall_and_write_metrics_split(tmp_path):
+    from dist_keras_tpu.observability import metrics
+
+    h0 = metrics.snapshot()["histograms"]
+    base_stall = h0.get("ckpt.save_stall_s", {}).get("count", 0)
+    base_write = h0.get("ckpt.write_s", {}).get("count", 0)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait(timeout_s=30)
+    h1 = metrics.snapshot()["histograms"]
+    assert h1["ckpt.save_stall_s"]["count"] == base_stall + 1
+    assert h1["ckpt.write_s"]["count"] == base_write + 1
+
+
+def test_async_events_emitted(tmp_path, monkeypatch):
+    from dist_keras_tpu.observability import events
+
+    obs = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(obs))
+    events.reset()
+    try:
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=10)
+        gate = threading.Event()
+        orig = ck._write_payload
+
+        def slow(tmp, state, shard_specs=None):
+            gate.wait(10)
+            return orig(tmp, state, shard_specs)
+
+        ck._write_payload = slow
+        ck.save(1, _state(1.0))
+        time.sleep(0.05)
+        ck.save(2, _state(2.0))
+        h3 = ck.save(3, _state(3.0))   # coalesces 2 away
+        gate.set()
+        h3.wait(timeout_s=30)
+        ck.wait_until_finished(timeout_s=30)
+    finally:
+        events.reset()
+        monkeypatch.delenv("DK_OBS_DIR")
+        events.reset()
+    lines = [json.loads(ln) for ln in
+             (obs / "events-rank_0.jsonl").read_text().splitlines()]
+    kinds = [ln["kind"] for ln in lines]
+    assert kinds.count("ckpt_async_enqueue") == 3
+    co = [ln for ln in lines if ln["kind"] == "ckpt_async_coalesced"]
+    assert len(co) == 1 and co[0]["step"] == 2 and co[0]["by"] == 3
+    saved = [ln["step"] for ln in lines if ln["kind"] == "ckpt_save"]
+    assert saved == [1, 3]
+
+
+def test_pod_saves_backpressure_instead_of_coalescing(tmp_path):
+    """world > 1 two-phase: coalescing is FORBIDDEN — one host
+    skipping step S latest-wins while its peers stage it would strand
+    the leader's marker wait.  The queue stays depth-1 and save()
+    blocks until the pending slot frees; every step's marker lands."""
+    ck = Checkpointer(str(tmp_path), rank=1, world=2, max_to_keep=10)
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    ck.save(1, _state(1.0))
+    time.sleep(0.05)          # writer picks up save 1 (held at gate)
+    h2 = ck.save(2, _state(2.0))   # pending slot
+    done = []
+
+    def third():
+        done.append(ck.save(3, _state(3.0)))
+
+    t = threading.Thread(target=third)
+    t.start()
+    time.sleep(0.2)
+    assert not done            # backpressured, NOT coalescing 2 away
+    gate.set()
+    t.join(timeout=30)
+    assert done
+    ck.wait_until_finished(timeout_s=30)
+    assert h2.status == "committed"    # step 2 was never superseded
+    # every step's phase-1 marker landed in the staging dir
+    for s in (1, 2, 3):
+        stage = os.path.join(str(tmp_path), f"step_{s:08d}.mh")
+        assert os.path.exists(os.path.join(stage, "host-1.ok")), s
+
+
+def test_two_phase_optout_pod_also_backpressures(tmp_path,
+                                                 monkeypatch):
+    """DK_CKPT_TWO_PHASE=0 (per-host LOCAL dirs) must backpressure
+    too: per-host latest-wins coalescing would punch holes in one
+    host's promoted-step sequence, and a relaunch would silently
+    resume ranks from different steps."""
+    monkeypatch.setenv("DK_CKPT_TWO_PHASE", "0")
+    ck = Checkpointer(str(tmp_path), rank=1, world=2, max_to_keep=10)
+    gate = threading.Event()
+    orig = ck._write_payload
+
+    def slow(tmp, state, shard_specs=None):
+        gate.wait(10)
+        return orig(tmp, state, shard_specs)
+
+    ck._write_payload = slow
+    ck.save(1, _state(1.0))
+    time.sleep(0.05)
+    h2 = ck.save(2, _state(2.0))   # pending — must NOT be coalesced
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(ck.save(3, _state(3.0))))
+    t.start()
+    time.sleep(0.2)
+    assert not done
+    gate.set()
+    t.join(timeout=30)
+    ck.wait_until_finished(timeout_s=30)
+    assert h2.status == "committed"
+    assert ck.all_steps() == [1, 2, 3]   # no holes in the sequence
+
+
+def test_wrong_shape_chunk_metadata_is_typed(tmp_path, monkeypatch):
+    """Valid JSON of the wrong SHAPE in chunks.json (rotted key, leaf
+    missing 'files', garbage dtype) convicts typed, even under
+    verify=False — never a bare KeyError/TypeError."""
+    _chunked(monkeypatch)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    cpath = tmp_path / "step_00000001" / CHUNKS_NAME
+    for rotted in ('{"format": 1, "lewves": []}',
+                   '{"format": 1, "leaves": [{"index": 0}]}',
+                   '{"format": 1, "leaves": [{"index": 0, "dtype": '
+                   '"nonsense", "shape": [4], "files": []}]}',
+                   '{"format": 1, "leaves": 3}'):
+        cpath.write_text(rotted)
+        with pytest.raises(CheckpointCorrupt,
+                           match="metadata unreadable"):
+            ck.restore(step=1, verify=False)
+    # well-formed but EMPTY leaves table while small.pkl still holds a
+    # _ChunkRef: typed too (a bare KeyError here would misroute the
+    # supervisor's retryable/fatal classification)
+    cpath.write_text('{"format": 1, "leaves": []}')
+    with pytest.raises(CheckpointCorrupt, match="no leaf entry"):
+        ck.restore(step=1, verify=False)
+
+
+def test_idle_writer_retires_and_restarts_on_demand(tmp_path):
+    """The writer thread parks with no job pinned (the snapshot must
+    not stay resident in its frame) and retires after its idle window;
+    a later save restarts it transparently."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1.0)).wait(timeout_s=30)
+    t = ck._async_thread
+    assert t is not None and t.is_alive()
+    # the parked frame must not pin the job tuple (released before the
+    # condition wait) — inspect the writer frame's locals directly
+    import sys
+    time.sleep(0.1)
+    frames = sys._current_frames()
+    frame = frames.get(t.ident)
+    seen = {}
+    while frame is not None:
+        if frame.f_code.co_name == "_writer_loop":
+            seen = dict(frame.f_locals)
+            break
+        frame = frame.f_back
+    assert seen.get("job") is None and seen.get("state") is None
+    # a new save on the same (or a restarted) writer still lands
+    assert ck.save(2, _state(2.0)).wait(timeout_s=30) == 2
+
+
+# ---------------------------------------------------------------------
+# trainer boundary semantics
+# ---------------------------------------------------------------------
+
+def _tiny_trainer(ckdir, **kw):
+    import dist_keras_tpu as dk
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    n = 256
+    y = rng.integers(0, 2, n)
+    ds = Dataset({"features": rng.normal(size=(n, 16))
+                  .astype(np.float32),
+                  "label": y, "label_encoded": one_hot(y, 2)})
+    t = dk.SingleTrainer(
+        mnist_mlp(hidden=(8,), input_dim=16, num_classes=2),
+        batch_size=32, label_col="label_encoded", seed=0,
+        checkpoint_dir=ckdir, **kw)
+    return t, ds
+
+
+def test_preempt_mid_async_save_waits_and_verifies(tmp_path):
+    """SIGTERM at a chunk boundary: Preempted.saved_step must name a
+    step that is PROMOTED and VERIFIED even though the cadence saves
+    run through the background writer."""
+    from dist_keras_tpu.resilience.preemption import Preempted
+
+    ckdir = str(tmp_path / "ck")
+    t, ds = _tiny_trainer(ckdir, num_epoch=40, checkpoint_every=1,
+                          handle_preemption=True)
+
+    fired = []
+
+    def cb(trainer, epoch, logs):
+        if epoch >= 2 and not fired:
+            fired.append(epoch)
+            preemption.request()
+
+    t.callbacks = [cb]
+    with pytest.raises(Preempted) as ei:
+        t.train(ds)
+    saved = ei.value.saved_step
+    assert saved is not None and saved > 0
+    ck = Checkpointer(ckdir)
+    assert ck.wait_until_finished(timeout_s=1) is True  # drained
+    assert ck.latest_step() == saved
+    assert ck.verify(saved) == "ok"
+    # the relaunch resumes from exactly that step
+    t2, _ = _tiny_trainer(ckdir, num_epoch=40, checkpoint_every=1,
+                          resume=saved)
+    t2.train(ds)
+    assert t2.metrics[-1]["epoch"] == 40
+
+
+def test_train_end_drains_inflight_saves(tmp_path):
+    """A completed train() must leave its final boundary save promoted
+    (the end-of-run drain), with no background writer still running."""
+    ckdir = str(tmp_path / "ck")
+    t, ds = _tiny_trainer(ckdir, num_epoch=6, checkpoint_every=2)
+    t.train(ds)
+    spe = 256 // 32
+    ck = Checkpointer(ckdir)
+    assert ck.wait_until_finished(timeout_s=1) is True
+    assert ck.latest_step() == 6 * spe
+    assert ck.verify(6 * spe) == "ok"
+
+
+# ---------------------------------------------------------------------
+# readers of the chunked format: serving watcher + elastic reshard
+# ---------------------------------------------------------------------
+
+def test_watcher_hot_loads_chunked_async_checkpoint(tmp_path,
+                                                    monkeypatch):
+    _chunked(monkeypatch)
+    pytest.importorskip("jax")
+    import jax
+
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.serving.engine import ServingEngine
+    from dist_keras_tpu.serving.reload import CheckpointWatcher
+
+    m = mnist_mlp(hidden=(8,), input_dim=16, num_classes=2)
+    eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    try:
+        rows = np.random.default_rng(0).normal(
+            size=(4, 16)).astype(np.float32)
+        base = eng.predict(rows, timeout_s=120)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        w = CheckpointWatcher(eng, ck, poll_s=0.5)
+        scaled = {"params": jax.tree.map(
+            lambda a: np.asarray(a, dtype=np.float64) * 0.25,
+            m.params)}
+        # big enough to actually chunk under the 0.25 MB test size?
+        # irrelevant — the watcher must read the format either way
+        ck.save(1, scaled)            # async, unwaited: the watcher
+        assert w.poll_once() == 1     # only ever sees PROMOTED steps
+        after = eng.predict(rows, timeout_s=120)
+        assert not np.allclose(after, base)
+    finally:
+        eng.close()
+
+
+def test_elastic_reshard_of_chunked_two_phase_checkpoint(tmp_path,
+                                                         monkeypatch):
+    """World-2 chunked async saves -> world-1 resharding restore gathers
+    the chunked shards by global index, bit-equal."""
+    from dist_keras_tpu.resilience import elastic
+
+    _chunked(monkeypatch)
+    g = np.arange(2 ** 16, dtype=np.float64)
+    dims = {"w": 0, "c": None}
+    for rank in (1, 0):
+        Checkpointer(str(tmp_path), rank=rank, world=2).save(
+            5, {"w": elastic.split_leaf(g, 0, 2, rank),
+                "c": np.float32(7.0)},
+            shard_specs=dims).wait(timeout_s=60)
+    # chunk files exist inside each host payload
+    names = os.listdir(str(tmp_path / "step_00000005" / "host_0"))
+    assert any(n.startswith("chunk_") for n in names)
+    ck1 = Checkpointer(str(tmp_path), rank=0, world=1)
+    assert ck1.verify(5, all_hosts=True) == "ok"
+    step, got = ck1.restore()
+    assert step == 5
+    np.testing.assert_array_equal(got["w"], g)
+    assert got["c"] == np.float32(7.0)

@@ -41,7 +41,9 @@ def test_checkpointer_save_restore_retention(tmp_path):
              "epoch": jnp.asarray(0)}
     for step in [1, 2, 3]:
         state["epoch"] = jnp.asarray(step)
-        ck.save(step, state)
+        # waited per save: rapid unwaited async saves coalesce
+        # latest-wins (by design), and this test wants all three
+        ck.save(step, state).wait()
     assert ck.all_steps() == [2, 3]  # retention dropped step 1
     step, restored = ck.restore(template=state)
     assert step == 3

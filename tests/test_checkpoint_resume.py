@@ -185,16 +185,25 @@ def test_resume_cadence_from_nonmultiple_epoch(tmp_path):
                           checkpoint_every=3, max_checkpoints=10, **kw)
     t1.train(ds)
     # round 4: SingleTrainer's checkpoint counter is STEP-granular (like
-    # the windowed family's window counter) — epochs 3, 6, 7 in steps
+    # the windowed family's window counter) — epochs 3, 6, 7 in steps.
+    # Async saves (DK_CKPT_ASYNC, default on) may COALESCE an
+    # intermediate cadence save latest-wins when the next boundary
+    # arrives before its write starts, so the assertion is: every step
+    # on disk sits ON the cadence grid, and the final boundary save
+    # always lands (the end-of-run drain waits on it).
     spb = len(ds) // 16
-    assert t1._checkpointer.all_steps() == [3 * spb, 6 * spb, 7 * spb]
+    steps1 = t1._checkpointer.all_steps()
+    assert steps1 and set(steps1) <= {3 * spb, 6 * spb, 7 * spb}
+    assert steps1[-1] == 7 * spb
 
     t2 = dk.SingleTrainer(_model(), num_epoch=13, checkpoint_dir=ckdir,
                           checkpoint_every=3, max_checkpoints=10,
                           resume=True, **kw)
     t2.train(ds)
     # saves continue every 3 epochs from the resume point (7): 10, 13
-    assert t2._checkpointer.all_steps()[-2:] == [10 * spb, 13 * spb]
+    steps2 = [s for s in t2._checkpointer.all_steps() if s > 7 * spb]
+    assert steps2 and set(steps2) <= {10 * spb, 13 * spb}
+    assert steps2[-1] == 13 * spb
 
 
 def test_checkpoint_every_requires_dir():
@@ -293,7 +302,7 @@ def test_resume_restore_errors_stay_typed(
 
     # corrupt-with-no-fallback: the typed verdict must surface as-is
     ckdir = str(tmp_path / "ck")
-    Checkpointer(ckdir).save(1, {"w": np.arange(8.0)})
+    Checkpointer(ckdir).save(1, {"w": np.arange(8.0)}).wait()
     flip_one_byte(os.path.join(ckdir, "step_00000001"))
     t = dk.SingleTrainer(_model(), num_epoch=1, checkpoint_dir=ckdir,
                          resume=True, **kw)
@@ -302,7 +311,7 @@ def test_resume_restore_errors_stay_typed(
 
     # transient I/O during restore: propagates as OSError, retryable
     ck2dir = str(tmp_path / "ck2")
-    Checkpointer(ck2dir).save(1, {"w": np.arange(8.0)})
+    Checkpointer(ck2dir).save(1, {"w": np.arange(8.0)}).wait()
 
     def _disk_died(self, step=None, template=None, verify=None):
         raise OSError("I/O error reading payload")

@@ -242,7 +242,10 @@ def test_two_phase_commit_promotes_only_when_all_markers_land(tmp_path):
     NO step: latest_step stays empty until the leader, finding every
     marker, promotes the staging directory."""
     ck1 = _ckptr(tmp_path, rank=1, world=2)
-    ck1.save(5, _state(1, 5))
+    # .wait(): async saves (the default) resolve the non-leader handle
+    # at marker publish, the leader handle at promotion — these tests
+    # inspect the staging layout between those instants
+    ck1.save(5, _state(1, 5)).wait()
     # staged, marked — but invisible to every reader
     stage = os.path.join(ck1.directory, "step_00000005.mh")
     assert os.path.isdir(os.path.join(stage, "host_1"))
@@ -251,7 +254,7 @@ def test_two_phase_commit_promotes_only_when_all_markers_land(tmp_path):
     assert ck1.latest_step() is None
 
     ck0 = _ckptr(tmp_path, rank=0, world=2)
-    ck0.save(5, _state(0, 5))  # leader: marker set complete -> promote
+    ck0.save(5, _state(0, 5)).wait()  # leader: all markers -> promote
     assert not os.path.exists(stage)
     assert ck0.all_steps() == [5]
     # each rank restores ITS OWN payload from the promoted step
@@ -269,12 +272,13 @@ def test_torn_commit_is_invisible_and_resume_falls_back(tmp_path):
     committed step on every rank."""
     ck0 = _ckptr(tmp_path, rank=0, world=2)
     ck1 = _ckptr(tmp_path, rank=1, world=2)
-    ck1.save(2, _state(1, 2))
-    ck0.save(2, _state(0, 2))  # step 2 fully committed
-    ck1.save(4, _state(1, 4))
+    ck1.save(2, _state(1, 2)).wait()
+    ck0.save(2, _state(0, 2)).wait()  # step 2 fully committed
+    ck1.save(4, _state(1, 4)).wait()
     with faults.armed("coord.commit"):
         with pytest.raises(faults.FaultInjected):
-            ck0.save(4, _state(0, 4))  # dies at the promotion instant
+            # dies at the promotion instant (surfaced by the wait)
+            ck0.save(4, _state(0, 4)).wait()
     # torn: all data + markers staged, nothing promoted
     assert os.path.isdir(os.path.join(ck0.directory, "step_00000004.mh"))
     for ck, rank in ((_ckptr(tmp_path, rank=0, world=2), 0),
@@ -288,9 +292,9 @@ def test_torn_commit_is_invisible_and_resume_falls_back(tmp_path):
     # the retried save at the same step supersedes the torn staging
     # (each rank retracts its own stale marker before rewriting)
     ck1b = _ckptr(tmp_path, rank=1, world=2)
-    ck1b.save(4, _state(1, 4))
+    ck1b.save(4, _state(1, 4)).wait()
     ck0b = _ckptr(tmp_path, rank=0, world=2)
-    ck0b.save(4, _state(0, 4))
+    ck0b.save(4, _state(0, 4)).wait()
     assert ck0b.all_steps() == [2, 4]
     step, got = ck0b.restore(template=_state(0, 4))
     assert step == 4
@@ -304,7 +308,7 @@ def test_leader_times_out_typed_when_marker_never_lands(tmp_path):
     ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=0.3)
     t0 = time.monotonic()
     with pytest.raises(BarrierTimeout, match=r"\[1\]"):
-        ck0.save(7, _state(0, 7))
+        ck0.save(7, _state(0, 7)).wait()
     assert time.monotonic() - t0 < 5.0
     assert ck0.all_steps() == []  # nothing half-committed
 
@@ -325,7 +329,7 @@ def test_leader_peer_lost_with_heartbeat_evidence(tmp_path, monkeypatch):
     ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=30.0)
     t0 = time.monotonic()
     with pytest.raises(PeerLost) as ei:
-        ck0.save(7, _state(0, 7))
+        ck0.save(7, _state(0, 7)).wait()
     assert ei.value.ranks == (1,)
     assert time.monotonic() - t0 < 10.0  # early, not the 30s deadline
 
@@ -337,12 +341,12 @@ def test_mid_write_kill_on_one_host_never_commits(tmp_path):
     ck1 = _ckptr(tmp_path, rank=1, world=2)
     with faults.armed("checkpoint.save"):
         with pytest.raises(faults.FaultInjected):
-            ck1.save(3, _state(1, 3))
+            ck1.save(3, _state(1, 3)).wait()
     stage = os.path.join(ck1.directory, "step_00000003.mh")
     assert not os.path.exists(os.path.join(stage, "host-1.ok"))
     ck0 = _ckptr(tmp_path, rank=0, world=2, commit_timeout_s=0.3)
     with pytest.raises(BarrierTimeout):  # no liveness evidence here
-        ck0.save(3, _state(0, 3))
+        ck0.save(3, _state(0, 3)).wait()
     assert ck0.all_steps() == []
 
 
@@ -359,8 +363,8 @@ def test_missing_own_payload_in_committed_step_is_an_error(tmp_path):
 
     ck1 = _ckptr(tmp_path, rank=1, world=2)
     ck0 = _ckptr(tmp_path, rank=0, world=2)
-    ck1.save(4, _state(1, 4))
-    ck0.save(4, _state(0, 4))
+    ck1.save(4, _state(1, 4)).wait()
+    ck0.save(4, _state(0, 4)).wait()
     shutil.rmtree(os.path.join(ck0.directory, "step_00000004",
                                "host_1"))
     with pytest.raises(RuntimeError, match="host_1"):
@@ -407,18 +411,18 @@ def test_leader_gc_spares_a_peers_newer_inflight_staging(tmp_path):
     # a torn OLD staging (step 1) and a peer's in-flight NEWER one
     # (step 9, data + marker already landed, leader not there yet)
     os.makedirs(os.path.join(ck0.directory, "step_00000001.mh"))
-    ck1.save(9, _state(1, 9))
+    ck1.save(9, _state(1, 9)).wait()
     newer = os.path.join(ck0.directory, "step_00000009.mh")
     assert os.path.isdir(newer)
     # the cluster commits step 5
-    ck1.save(5, _state(1, 5))
-    ck0.save(5, _state(0, 5))
+    ck1.save(5, _state(1, 5)).wait()
+    ck0.save(5, _state(0, 5)).wait()
     assert ck0.all_steps() == [5]
     assert not os.path.exists(
         os.path.join(ck0.directory, "step_00000001.mh"))  # swept
     assert os.path.exists(os.path.join(newer, "host-1.ok"))  # spared
     # and the spared staging completes into a real commit
-    ck0.save(9, _state(0, 9))
+    ck0.save(9, _state(0, 9)).wait()
     assert ck0.all_steps() == [5, 9]
 
 
@@ -441,7 +445,7 @@ def test_single_host_save_layout_unchanged(tmp_path):
     no markers — old checkpoints stay readable, new ones stay readable
     by old code."""
     ck = _ckptr(tmp_path, rank=0, world=1)
-    ck.save(1, {"a": np.ones(3)})
+    ck.save(1, {"a": np.ones(3)}).wait()
     names = sorted(os.listdir(os.path.join(ck.directory,
                                            "step_00000001")))
     assert not any(n.startswith("host") for n in names)
@@ -638,7 +642,7 @@ def test_two_phase_opt_out_keeps_per_host_independent_saves(
     own GC and retention."""
     monkeypatch.setenv("DK_CKPT_TWO_PHASE", "0")
     ck1 = _ckptr(tmp_path, rank=1, world=2)
-    ck1.save(5, _state(1, 5))
+    ck1.save(5, _state(1, 5)).wait()
     assert ck1.all_steps() == [5]  # committed alone, no marker wait
     names = os.listdir(os.path.join(ck1.directory, "step_00000005"))
     assert not any(n.startswith("host") for n in names)  # old layout
@@ -646,7 +650,7 @@ def test_two_phase_opt_out_keeps_per_host_independent_saves(
     assert step == 5
     orphan = os.path.join(ck1.directory, "step_00000001.tmp")
     os.makedirs(orphan)
-    ck1.save(6, _state(1, 6))      # non-leader still sweeps ITS dir
+    ck1.save(6, _state(1, 6)).wait()  # non-leader still sweeps ITS dir
     assert not os.path.exists(orphan)
 
 

@@ -52,9 +52,13 @@ def _save_world(directory, state, world, specs=_DIMS, step=5):
     non-leader publishes its payload + marker first, the leader's save
     then finds all markers present and promotes."""
     for rank in list(range(1, world)) + [0]:
+        # waited per rank: the restore below uses a FRESH Checkpointer,
+        # so the async default's join-on-read can't cover it — and the
+        # leader's promote needs every marker down first anyway
         Checkpointer(directory, rank=rank, world=world,
                      max_to_keep=10).save(
-            step, _local(state, world, rank), shard_specs=specs)
+            step, _local(state, world, rank),
+            shard_specs=specs).wait(timeout_s=60)
 
 
 def _assert_tree_equal(got, want):
@@ -136,8 +140,8 @@ def test_reshard_roundtrip_bit_equal(tmp_path, n, m):
     # the M=1 view IS the single-host reference: a world-1 save of the
     # same global state restores bit-identically
     ref_dir = str(tmp_path / "ref")
-    Checkpointer(ref_dir, rank=0, world=1).save(5, _local(g, 1, 0),
-                                                shard_specs=_DIMS)
+    Checkpointer(ref_dir, rank=0, world=1).save(
+        5, _local(g, 1, 0), shard_specs=_DIMS).wait(timeout_s=60)
     _step, ref = Checkpointer(ref_dir, rank=0, world=1).restore()
     _step, got = Checkpointer(str(tmp_path), rank=0, world=1).restore()
     _assert_tree_equal(got, ref)
@@ -176,7 +180,7 @@ def test_reshard_with_fsdp_partition_specs(tmp_path):
 
     for rank in (1, 0):
         Checkpointer(str(tmp_path), rank=rank, world=2).save(
-            5, local(rank), shard_specs=specs)
+            5, local(rank), shard_specs=specs).wait(timeout_s=60)
     step, st = Checkpointer(str(tmp_path), rank=0, world=1).restore()
     assert step == 5
     _assert_tree_equal(st, g)
@@ -225,7 +229,8 @@ def test_saved_world_and_payload_paths(tmp_path):
     paths = ck.host_payload_paths(5)
     assert [os.path.basename(p) for p in paths] == ["host_0", "host_1"]
     single = str(tmp_path / "one")
-    Checkpointer(single, rank=0, world=1).save(5, _local(g, 1, 0))
+    Checkpointer(single, rank=0, world=1).save(
+        5, _local(g, 1, 0)).wait(timeout_s=60)
     one = Checkpointer(single, rank=0, world=1)
     assert one.saved_world() == 1
     assert one.host_payload_paths(5) == [

@@ -324,7 +324,7 @@ def test_failed_restore_emits_nothing(obs_dir, tmp_path):
     from dist_keras_tpu.checkpoint import Checkpointer
 
     ck = Checkpointer(tmp_path / "ck")
-    ck.save(1, {"x": np.arange(3)})
+    ck.save(1, {"x": np.arange(3)}).wait()
     pkl = tmp_path / "ck" / "step_00000001" / "state.pkl"
     if pkl.exists():  # corrupt the payload, whichever format wrote it
         pkl.write_bytes(b"not a pickle")

@@ -208,10 +208,12 @@ def test_checkpoint_kill_mid_write_leaves_previous_step_restorable(
     the previous committed step bit-exactly."""
     ck = _ckptr(tmp_path)
     state1 = {"a": np.arange(4.0), "b": np.int32(3)}
-    ck.save(1, state1)
+    ck.save(1, state1).wait()
     with faults.armed("checkpoint.save"):
         with pytest.raises(FaultInjected):
-            ck.save(2, {"a": np.arange(4.0) * 2, "b": np.int32(9)})
+            # async (the default): the injected kill surfaces on the
+            # handle — wait() is the durability barrier
+            ck.save(2, {"a": np.arange(4.0) * 2, "b": np.int32(9)}).wait()
     names = sorted(os.listdir(ck.directory))
     assert any(n.startswith("step_00000002") for n in names)  # orphan tmp
     assert "step_00000002" not in names                       # no commit
@@ -225,7 +227,7 @@ def test_checkpoint_kill_mid_write_leaves_previous_step_restorable(
     # the orphan tmp is garbage-collected by the writer's NEXT
     # successful commit (never by a read-only query — see the
     # concurrent-reader test below)
-    ck2.save(3, state1)
+    ck2.save(3, state1).wait()
     assert not any("tmp" in n for n in os.listdir(ck2.directory))
     assert ck2.all_steps() == [1, 3]
 
@@ -243,7 +245,7 @@ def test_checkpoint_save_gives_up_after_budget(tmp_path):
     ck = _ckptr(tmp_path)
     faults.inject("checkpoint.save", at=0, times=99, exc=OSError)
     with pytest.raises(OSError):
-        ck.save(5, {"a": np.ones(3)})
+        ck.save(5, {"a": np.ones(3)}).wait()
     ck2 = _ckptr(tmp_path)
     assert ck2.all_steps() == []  # nothing half-committed
 
@@ -262,10 +264,10 @@ def test_checkpoint_overwrite_kill_mid_swap_keeps_old_version(tmp_path):
     new step_N must not lose the committed version: all_steps() rolls
     the .old back."""
     ck = _ckptr(tmp_path)
-    ck.save(3, {"a": np.zeros(2)})
+    ck.save(3, {"a": np.zeros(2)}).wait()
     with faults.armed("checkpoint.commit"):
         with pytest.raises(FaultInjected):
-            ck.save(3, {"a": np.full(2, 7.0)})
+            ck.save(3, {"a": np.full(2, 7.0)}).wait()
     names = sorted(os.listdir(ck.directory))
     assert "step_00000003" not in names        # mid-swap state on disk
     assert "step_00000003.old" in names
@@ -280,7 +282,7 @@ def test_checkpoint_reader_never_deletes_writer_staging(tmp_path):
     """A read-only poller (second Checkpointer on the same directory)
     must not GC another process's in-progress tmp dir."""
     ck = _ckptr(tmp_path)
-    ck.save(1, {"a": np.zeros(2)})
+    ck.save(1, {"a": np.zeros(2)}).wait()
     staging = os.path.join(ck.directory, "step_00000002.tmp")
     os.makedirs(staging)  # a concurrent writer mid-save
     reader = _ckptr(tmp_path)
@@ -292,7 +294,9 @@ def test_checkpoint_reader_never_deletes_writer_staging(tmp_path):
 def test_checkpoint_retention_still_prunes(tmp_path):
     ck = _ckptr(tmp_path, max_to_keep=2)
     for s in (1, 2, 3, 4):
-        ck.save(s, {"a": np.float32(s)})
+        # waited per save: rapid UNwaited saves legitimately coalesce
+        # latest-wins under DK_CKPT_ASYNC (tests/test_async_ckpt.py)
+        ck.save(s, {"a": np.float32(s)}).wait()
     assert ck.all_steps() == [3, 4]
 
 

@@ -51,6 +51,10 @@ def _state(scale=1.0):
 
 
 def _payload(ck, step):
+    # drain the async writer first: these tests poke the committed
+    # bytes directly, and with DK_CKPT_ASYNC (default on) a just-issued
+    # save may still be streaming out of the background thread
+    ck.wait_until_finished()
     return os.path.join(ck.directory, f"step_{step:08d}")
 
 
@@ -178,9 +182,9 @@ def test_restore_falls_back_past_corrupt_latest_and_quarantines(
         tmp_path, flip_one_byte):
     ck = Checkpointer(str(tmp_path), max_to_keep=5)
     s1, s2, s3 = _state(1.0), _state(3.0), _state(7.0)
-    ck.save(1, s1)
-    ck.save(2, s2)
-    ck.save(3, s3)
+    ck.save(1, s1).wait()   # waited: back-to-back UNwaited saves would
+    ck.save(2, s2).wait()   # coalesce latest-wins (by design) and this
+    ck.save(3, s3).wait()   # test needs all three steps on disk
     flip_one_byte(_payload(ck, 3))
     step, restored = ck.restore()
     assert step == 2
@@ -195,9 +199,9 @@ def test_restore_falls_back_past_corrupt_latest_and_quarantines(
 def test_restore_cascades_past_two_corrupt_steps(tmp_path, flip_one_byte):
     ck = Checkpointer(str(tmp_path), max_to_keep=5)
     s1 = _state(1.0)
-    ck.save(1, s1)
-    ck.save(2, _state(3.0))
-    ck.save(3, _state(7.0))
+    ck.save(1, s1).wait()
+    ck.save(2, _state(3.0)).wait()
+    ck.save(3, _state(7.0)).wait()
     flip_one_byte(_payload(ck, 3))
     flip_one_byte(_payload(ck, 2))
     step, restored = ck.restore()
@@ -232,8 +236,8 @@ def test_multihost_restore_refuses_per_rank_fallback(
         return {"w": np.arange(16.0) + 10 * rank + step}
 
     for step in (2, 4):
-        _mh(1).save(step, _st(1, step))
-        _mh(0).save(step, _st(0, step))  # leader promotes
+        _mh(1).save(step, _st(1, step)).wait()
+        _mh(0).save(step, _st(0, step)).wait()  # leader promotes
     flip_one_byte(str(tmp_path / "step_00000004" / "host_1"))
 
     with pytest.raises(CheckpointCorrupt) as ei:
@@ -316,8 +320,8 @@ def test_latest_verified_step_empty_dir_is_none(tmp_path):
 def test_retention_eventually_retires_quarantined_evidence(
         tmp_path, flip_one_byte):
     ck = Checkpointer(str(tmp_path), max_to_keep=2)
-    ck.save(1, _state(1.0))
-    ck.save(2, _state(2.0))
+    ck.save(1, _state(1.0)).wait()
+    ck.save(2, _state(2.0)).wait()
     flip_one_byte(_payload(ck, 2))
     with pytest.raises(CheckpointCorrupt):
         ck.verify(2)
@@ -325,11 +329,11 @@ def test_retention_eventually_retires_quarantined_evidence(
     quarantined = str(tmp_path / "step_00000002.corrupt")
     assert os.path.isdir(quarantined)
     # quarantine survives saves while its step is on the live horizon
-    ck.save(3, _state(3.0))
+    ck.save(3, _state(3.0)).wait()
     assert os.path.isdir(quarantined)
     # ...and is retired once retention moves past it
-    ck.save(4, _state(4.0))
-    ck.save(5, _state(5.0))
+    ck.save(4, _state(4.0)).wait()
+    ck.save(5, _state(5.0)).wait()
     assert not os.path.isdir(quarantined)
 
 

@@ -300,7 +300,7 @@ def test_checkpoint_watcher_skips_corrupt_step(tmp_path, flip_one_byte):
         ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
         w = CheckpointWatcher(eng, ck, poll_s=0.5)
         ck.save(1, {"params": jax.tree.map(
-            lambda a: np.asarray(a) * 0.25, m.params)})
+            lambda a: np.asarray(a) * 0.25, m.params)}).wait()
         flip_one_byte(str(tmp_path / "ck" / "step_00000001"))
         assert w.poll_once() is None  # skipped, not raised
         assert w.skipped_corrupt == 1 and w.reloads == 0
@@ -336,8 +336,8 @@ def test_checkpoint_watcher_falls_back_to_newest_verified_step(
             return {"params": jax.tree.map(
                 lambda a: np.asarray(a) * k, m.params)}
 
-        ck.save(1, scale(0.25))
-        ck.save(2, scale(0.5))
+        ck.save(1, scale(0.25)).wait()
+        ck.save(2, scale(0.5)).wait()
         flip_one_byte(str(tmp_path / "ck" / "step_00000002"))
         assert w.poll_once() == 1      # newest VERIFIABLE, not None
         assert w.reloads == 1 and w.skipped_corrupt == 1
@@ -365,8 +365,8 @@ def test_checkpoint_watcher_restore_failure_keeps_convictions(
     try:
         ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=5)
         w = CheckpointWatcher(eng, ck, poll_s=0.5, initial_step=0)
-        ck.save(1, {"params": m.params})
-        ck.save(2, {"params": m.params})
+        ck.save(1, {"params": m.params}).wait()
+        ck.save(2, {"params": m.params}).wait()
         flip_one_byte(str(tmp_path / "ck" / "step_00000002"))
         real_restore = ck.restore
         monkeypatch.setattr(
@@ -400,7 +400,7 @@ def test_checkpoint_watcher_never_quarantines_rot_after_probe(
     try:
         ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
         w = CheckpointWatcher(eng, ck, poll_s=0.5, initial_step=0)
-        ck.save(1, {"params": m.params})
+        ck.save(1, {"params": m.params}).wait()
         # simulate rot-after-probe: the probe saw the step intact...
         monkeypatch.setattr(ck, "verify", lambda step=None: "ok")
         # ...then a listed hash rotted (payload bytes still loadable)
