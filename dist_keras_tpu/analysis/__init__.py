@@ -4,9 +4,14 @@ The runtime guards its invariants dynamically (chaos gate, watchdog,
 typed errors); this package guards the SOURCE invariants that used to
 live in comments and CHANGES.md prose: fault-point/knob/event/metric
 registry consistency (``registries``), signal-handler purity and
-never-throws observability entry points (``purity``), and seam hygiene
-— audited broad excepts, typed-error raises, jit-pure step functions
-(``hygiene``).
+never-throws observability entry points (``purity``), seam hygiene
+— audited broad excepts, typed-error raises, jit-pure step functions,
+stale-waiver detection (``hygiene`` + the ``unused-waiver`` sweep) —
+and, since round 15, the concurrency invariants (``concurrency`` over
+the ``threads`` registry): thread-root inventory, the
+acquires-while-holding lock-order graph, the >= 2-roots shared-state
+audit, bounded cross-thread waits, and no blocking calls under a
+registered lock.
 
 Run it as ``python -m dist_keras_tpu.analysis`` (see ``__main__``);
 ``gates.py --lint-only`` wraps it into the gate tier and
@@ -22,8 +27,9 @@ from dist_keras_tpu.analysis.core import (
     apply_baseline,
     load_baseline,
     run_analysis,
+    rules_table,
     write_baseline,
 )
 
-__all__ = ["RULES", "Finding", "run_analysis", "load_baseline",
-           "write_baseline", "apply_baseline"]
+__all__ = ["RULES", "Finding", "run_analysis", "rules_table",
+           "load_baseline", "write_baseline", "apply_baseline"]

@@ -64,6 +64,9 @@ def main(argv=None):
     ap.add_argument("--knob-table", action="store_true",
                     help="print the README knob table generated from "
                          "utils/knobs.py and exit")
+    ap.add_argument("--rules-table", action="store_true",
+                    help="print the README rules table generated from "
+                         "the --list-rules vocabulary and exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     args = ap.parse_args(argv)
@@ -72,6 +75,9 @@ def main(argv=None):
         from dist_keras_tpu.utils import knobs
 
         print(knobs.doc_table())
+        return 0
+    if args.rules_table:
+        print(core.rules_table())
         return 0
     if args.list_rules:
         for rule, doc in core.RULES.items():
@@ -91,7 +97,9 @@ def main(argv=None):
         cand = os.path.join(root, "analysis", "baseline.json")
         baseline_path = cand if os.path.exists(cand) else None
 
-    findings = core.run_analysis(root, readme=readme, rules=rules)
+    timings = {}
+    findings = core.run_analysis(root, readme=readme, rules=rules,
+                                 timings=timings)
 
     if args.write_baseline:
         # ALWAYS grandfather from an unfiltered run: writing a baseline
@@ -121,6 +129,11 @@ def main(argv=None):
             "baselined": len(findings) - len(fresh),
             "fresh": len(fresh),
             "counts": counts,
+            # per-pass wall seconds: the static_lint gate records
+            # these so a slow cross-module graph walk is visible in
+            # the gate JSON, and tests/test_dklint.py budgets the sum
+            "pass_seconds": {k: round(v, 4)
+                             for k, v in timings.items()},
             "findings": [f.to_dict() for f in fresh],
         }, indent=1))
     else:

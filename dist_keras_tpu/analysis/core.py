@@ -33,9 +33,11 @@ README.  Three building blocks live here:
 from __future__ import annotations
 
 import ast
+import io
 import json
 import os
 import re
+import tokenize
 
 # rule -> one-line description (the README rule table is this dict)
 RULES = {
@@ -106,7 +108,54 @@ RULES = {
     "jit-impure":
         "time.time()/perf_counter or random-module calls inside a "
         "jit-compiled function (traced once, frozen forever)",
+    "thread-root-unknown":
+        "a threading.Thread/Timer target or signal.signal handler does "
+        "not resolve to a named root in analysis/threads.py "
+        "KNOWN_THREAD_ROOTS (dynamic sites annotate "
+        "`# dklint: thread-root=<name>`)",
+    "thread-root-unused":
+        "a KNOWN_THREAD_ROOTS entry matches no registration site (dead "
+        "registry row), or a ~declared root names code that does not "
+        "exist",
+    "lock-order-cycle":
+        "the acquires-while-holding graph (observed `with lock:` "
+        "nesting and .acquire() reachability, plus the LOCK_ORDER "
+        "declarations) contains a cycle — a potential deadlock",
+    "unguarded-shared-write":
+        "an instance attribute written from >= 2 distinct thread roots "
+        "without a common guarding lock (and it is not a sync "
+        "primitive) — waive only with the safety argument (e.g. "
+        "reference assignment is atomic under the GIL)",
+    "unbounded-wait":
+        ".join()/.wait()/.wait_for()/lock.acquire()/future.result()/"
+        "queue.get() on a cross-thread seam without a timeout/deadline "
+        "— a wedged peer thread must cost one deadline, never a hang",
+    "blocking-under-lock":
+        "time.sleep, subprocess, socket/HTTP or a fault_point call "
+        "(chaos delay = a sleep) reachable while holding a registered "
+        "lock — every other acquirer stalls behind it",
+    "unused-waiver":
+        "a `# dklint: ignore[rule]` waiver whose rule no longer fires "
+        "at that site — stale waivers must not accumulate",
+    "rule-undocumented":
+        "the README has no `<!-- dklint: rules-table -->` marked table, "
+        "or a rule in core.RULES has no row in it",
+    "rule-doc-drift":
+        "the README rules table is out of sync with core.RULES "
+        "(regenerate with `python -m dist_keras_tpu.analysis "
+        "--rules-table`)",
 }
+
+
+def rules_table():
+    """The README rules table, generated from :data:`RULES` (the same
+    vocabulary ``--list-rules`` prints) — paste below the
+    ``<!-- dklint: rules-table -->`` marker; the ``rule-undocumented`` /
+    ``rule-doc-drift`` checks keep it strictly in sync both ways."""
+    lines = ["| rule | meaning |", "|---|---|"]
+    for rule, doc in RULES.items():
+        lines.append(f"| `{rule}` | {' '.join(doc.split())} |")
+    return "\n".join(lines)
 
 
 class Finding:
@@ -153,7 +202,8 @@ class SourceFile:
         self.tree = ast.parse(text)  # SyntaxError handled by load_tree
         self.waivers = {}      # lineno (1-based) -> set of rule names
         self.annotations = {}  # lineno -> {key: [values]}
-        for i, line in enumerate(self.lines, start=1):
+        self.used_waivers = set()  # (waiver lineno, rule) that fired
+        for i, line in self._comments():
             m = _WAIVER_RE.search(line)
             if m:
                 rules = {r.strip() for r in m.group(1).split(",")
@@ -164,6 +214,22 @@ class SourceFile:
                 values = [v.strip() for v in m.group(2).split(",")
                           if v.strip()]
                 self.annotations.setdefault(i, {})[m.group(1)] = values
+
+    def _comments(self):
+        """-> (lineno, text) of every real ``#`` comment, via tokenize —
+        a docstring or string literal that merely *mentions*
+        ``dklint: ignore[...]`` (the analyzer's own docs do) must
+        neither waive anything nor trip the ``unused-waiver`` sweep."""
+        try:
+            return [(tok.start[0], tok.string) for tok in
+                    tokenize.generate_tokens(
+                        io.StringIO(self.text).readline)
+                    if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            # the file parsed (SourceFile requires it), so this is an
+            # exotic edge — fall back to the line scan rather than
+            # silently dropping every waiver in the file
+            return list(enumerate(self.lines, start=1))
 
     def _comment_block(self, lineno):
         """The flagged line plus the contiguous run of comment-only
@@ -177,9 +243,13 @@ class SourceFile:
 
     def waived(self, rule, lineno):
         """A waiver applies on the flagged line or anywhere in the
-        comment block immediately above it."""
-        return any(rule in self.waivers.get(ln, ())
-                   for ln in self._comment_block(lineno))
+        comment block immediately above it.  A match records the waiver
+        line as USED — the ``unused-waiver`` sweep flags the rest."""
+        for ln in self._comment_block(lineno):
+            if rule in self.waivers.get(ln, ()):
+                self.used_waivers.add((ln, rule))
+                return True
+        return False
 
     def annotation(self, key, lineno):
         """-> the annotated value list at this site, or None."""
@@ -243,20 +313,44 @@ def load_tree(root, readme=None):
                    parse_findings=parse_findings)
 
 
-def run_analysis(root, readme=None, rules=None):
+def run_analysis(root, readme=None, rules=None, timings=None):
     """Run every pass over ``root`` -> sorted list of :class:`Finding`.
 
     ``readme``: path for the doc-sync rules (None = skipped).
     ``rules``: optional iterable restricting which rule names report.
+    ``timings``: optional dict filled with per-pass wall seconds (the
+    ``static_lint`` gate records them, and ``tests/test_dklint.py``
+    budgets the total so the cross-module graph walks cannot quietly
+    slow tier-1's self-check).
     """
-    # late imports: the passes import this module for Finding
-    from dist_keras_tpu.analysis import hygiene, registries, purity
+    import time as _time
 
+    # late imports: the passes import this module for Finding
+    from dist_keras_tpu.analysis import (
+        concurrency,
+        hygiene,
+        registries,
+        purity,
+    )
+
+    if timings is None:
+        timings = {}
+    t0 = _time.perf_counter()
     project = load_tree(root, readme=readme)
+    timings["load"] = _time.perf_counter() - t0
     findings = list(project.parse_findings)
-    findings += registries.run(project)
-    findings += purity.run(project)
-    findings += hygiene.run(project)
+    for name, pass_run in (("registries", registries.run),
+                           ("purity", purity.run),
+                           ("hygiene", hygiene.run),
+                           ("concurrency", concurrency.run)):
+        t0 = _time.perf_counter()
+        findings += pass_run(project)
+        timings[name] = _time.perf_counter() - t0
+    # the unused-waiver sweep runs LAST: only after every pass consulted
+    # its waivers do we know which `# dklint: ignore[...]` lines fired
+    t0 = _time.perf_counter()
+    findings += _unused_waivers(project)
+    timings["waivers"] = _time.perf_counter() - t0
     if rules is not None:
         # syntax-error is never filterable: a --rules run that silently
         # skipped an unparseable file would report "clean" on a tree
@@ -267,6 +361,51 @@ def run_analysis(root, readme=None, rules=None):
             raise ValueError(f"unknown rule name(s): {sorted(unknown)}")
         findings = [f for f in findings if f.rule in allowed]
     return sorted(findings, key=Finding.sort_key)
+
+
+def _unused_waivers(project):
+    """A waiver whose rule never fired at its site is itself a finding
+    — stale ``ignore[...]`` comments must not accumulate as the code
+    under them is fixed or moves away."""
+    findings = []
+    for sf in project.files:
+        for lineno in sorted(sf.waivers):
+            for rule in sorted(sf.waivers[lineno]):
+                if (lineno, rule) in sf.used_waivers:
+                    continue
+                if rule == "unused-waiver":
+                    # the meta-waiver is consulted right below, never
+                    # by a pass — it cannot be "used" in the pass sense
+                    continue
+                if sf.waived("unused-waiver", lineno):
+                    continue
+                findings.append(Finding(
+                    "unused-waiver", sf.rel, lineno,
+                    f"waiver ignore[{rule}] no longer matches a "
+                    f"{rule} finding at this site — remove the stale "
+                    "waiver (or fix the drifted rule name)",
+                    key=f"unused-waiver:{rule}:{sf.line_text(lineno)}"))
+    return findings
+
+
+def import_bindings(tree):
+    """-> {local name: binding} for every import in ``tree`` — the one
+    extraction both cross-module call-graph walkers (the round-12
+    signal-safety pass and the round-15 concurrency pass) resolve
+    through.  ``import pkg.mod as m`` binds a dotted-module string;
+    ``from pkg import name`` / ``from pkg.mod import fn`` bind a
+    ``(module, name)`` tuple."""
+    bindings = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bindings[alias.asname
+                         or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                bindings[alias.asname or alias.name] = \
+                    (node.module, alias.name)
+    return bindings
 
 
 _BROAD_NAMES = ("Exception", "BaseException")

@@ -31,7 +31,11 @@ from __future__ import annotations
 
 import ast
 
-from dist_keras_tpu.analysis.core import Finding, is_broad_handler
+from dist_keras_tpu.analysis.core import (
+    Finding,
+    import_bindings,
+    is_broad_handler,
+)
 
 # (file basename, enclosing class or None, function name) — the
 # documented never-throws entry points
@@ -75,21 +79,13 @@ class _ModuleIndex:
     def __init__(self, sf):
         self.sf = sf
         self.functions = {}   # name -> FunctionDef (module-level only)
-        self.imports = {}     # local name -> dotted module or
-        #                       (module, attr) for from-imports
+        # local name -> dotted module or (module, attr) for
+        # from-imports — the shared core.import_bindings extraction
+        self.imports = import_bindings(sf.tree)
         for node in sf.tree.body:
             if isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
                 self.functions[node.name] = node
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    self.imports[alias.asname or
-                                 alias.name.split(".")[0]] = alias.name
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                for alias in node.names:
-                    self.imports[alias.asname or alias.name] = \
-                        (node.module, alias.name)
 
 
 def _handler_roots(index):

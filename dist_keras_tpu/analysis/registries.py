@@ -30,7 +30,7 @@ import ast
 import fnmatch
 import re
 
-from dist_keras_tpu.analysis.core import Finding
+from dist_keras_tpu.analysis.core import Finding, rules_table
 
 _METRIC_KINDS = ("counter", "gauge", "histogram")
 _DK_RE = re.compile(r"DK_[A-Z0-9_]+")
@@ -551,6 +551,45 @@ def _check_readme(project, knob_reg, event_reg, metric_reg):
                         f"README event-schema table names {tok!r} "
                         "which is not in events.KNOWN_EVENTS",
                         key=f"event-doc-drift:{tok}"))
+
+    # the analyzer's OWN rule table <-> core.RULES (marked, strict,
+    # generated by --rules-table — the five concurrency rules can never
+    # drift from the docs any more than the knobs/events/metrics can)
+    expected = rules_table().splitlines()[2:]
+    actual = _marked_table_data_lines(readme, "rules-table")
+    if actual is None:
+        findings.append(Finding(
+            "rule-undocumented", rel, 1,
+            "README has no `<!-- dklint: rules-table -->` marker "
+            "before the static-analysis rule table",
+            key="rules-table-marker"))
+    else:
+        actual_rows = [row for _, row in actual]
+        if actual_rows != expected:
+            missing = [r for r in expected if r not in actual_rows]
+            extra = [(ln, r) for ln, r in actual
+                     if r not in expected]
+            for row in missing:
+                name = row.split("`")[1]
+                findings.append(Finding(
+                    "rule-undocumented", rel,
+                    actual[0][0] if actual else 1,
+                    f"README rules table is missing/stale for rule "
+                    f"{name!r}: expected row {row!r} (regenerate with "
+                    "`python -m dist_keras_tpu.analysis "
+                    "--rules-table`)", key=f"rule-doc:{name}"))
+            for ln, row in extra:
+                findings.append(Finding(
+                    "rule-doc-drift", rel, ln,
+                    f"README rules table row {row!r} matches no rule "
+                    "in core.RULES (regenerate with --rules-table)",
+                    key=f"rule-doc-drift:{row}"))
+            if not missing and not extra:
+                findings.append(Finding(
+                    "rule-doc-drift", rel, actual[0][0],
+                    "README rules table rows are out of ORDER vs "
+                    "core.RULES (regenerate with --rules-table)",
+                    key="rules-table-order"))
 
     # metrics <-> the marked metrics table
     if metric_reg is not None:

@@ -924,6 +924,11 @@ class Checkpointer:
             return
         final = self._step_dir(step)
         tmp = final + ".tmp"
+        # _inflight is single-writer by construction: the sync path
+        # drains the async queue before writing and the writer thread
+        # is the only other author — reference assignment is atomic,
+        # and its one reader (_gc_orphans, same thread) is in-frame
+        # dklint: ignore[unguarded-shared-write] single writer at a time (sync save drains async first); atomic reference assignment
         self._inflight = os.path.basename(final)
         try:
             with span("ckpt.save", step=step):
@@ -931,6 +936,7 @@ class Checkpointer:
                                  shard_specs)
             self._gc_orphans()
         finally:
+            # dklint: ignore[unguarded-shared-write] same single-writer argument as the store above
             self._inflight = None
         self._retain()
         dt = _time.perf_counter() - t0
@@ -1335,6 +1341,7 @@ class Checkpointer:
         promotion before anyone exits."""
         final = self._step_dir(step)
         stage = self._staging_dir(step)
+        # dklint: ignore[unguarded-shared-write] single writer at a time (sync save drains async first); atomic reference assignment
         self._inflight = os.path.basename(final)
         try:
             # every attempt of _save_host_once retracts this rank's own
@@ -1346,6 +1353,7 @@ class Checkpointer:
                 self._promote(stage, final, world)
                 self._gc_orphans()
         finally:
+            # dklint: ignore[unguarded-shared-write] same single-writer argument as the store above
             self._inflight = None
         if rank == 0:
             self._retain()

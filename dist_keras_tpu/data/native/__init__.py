@@ -61,6 +61,10 @@ def load_fastcsv():
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        # dklint: ignore[blocking-under-lock] the lock's PURPOSE is to
+        # serialize the one-time g++ build: a concurrent caller must
+        # park behind the compile rather than race a second one; the
+        # subprocess itself is bounded (timeout=120)
         so = build_fastcsv()
         if so is None:
             return None

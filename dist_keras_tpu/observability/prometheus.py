@@ -166,6 +166,10 @@ class Exporter(ThreadingHTTPServer):
 
     def start(self):
         """Serve on a background thread; -> (host, bound_port)."""
+        # dklint: thread-root=obs.exporter
+        # (serve_forever is inherited from ThreadingHTTPServer, which
+        # then spawns one handler thread per request — the registry's
+        # ~_Handler.* row is where the off-main code actually runs)
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True,
             name="dk-metrics-exporter")

@@ -242,7 +242,11 @@ class MetricsSampler:
             # dklint: ignore[broad-except] a failed perf_sample is a dropped sample, not a dead sampler
             except Exception:  # pragma: no cover - dropped sample
                 pass
-        self.ticks += 1
+        # under the lock: tick() runs on the sampler thread AND from
+        # main (tests, stop(final_tick=True)) — a torn += would lose
+        # counts the idempotence tests assert on
+        with self._lock:
+            self.ticks += 1
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

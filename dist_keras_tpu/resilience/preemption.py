@@ -154,6 +154,7 @@ def restore():
         _prev.clear()
     for s, h in saved.items():
         try:
+            # dklint: thread-root=preempt.restore
             signal.signal(s, h)
         except (ValueError, TypeError):  # pragma: no cover
             pass

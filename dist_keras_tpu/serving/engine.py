@@ -282,6 +282,13 @@ class ServingEngine:
             while not self._pending:
                 if self._stopped or self._draining:
                     return None
+                # the batcher's idle park: deliberately unbounded —
+                # every producer (submit) and both lifecycle exits
+                # (drain/_shutdown_threads) notify under this cond,
+                # and shutdown re-checks _stopped/_draining above, so
+                # the wait ends with work or a lifecycle transition,
+                # never needs a wake-poll cadence
+                # dklint: ignore[unbounded-wait] idle park; all producers and lifecycle exits notify this cond
                 self._cond.wait()
             # at least one request: wait up to the latency bound for a
             # full largest rung — unless draining, which flushes NOW
@@ -364,6 +371,11 @@ class ServingEngine:
     # -- replicas -------------------------------------------------------
     def _replica_loop(self, rep):
         while True:
+            # the replica's idle park: deliberately unbounded — the
+            # batcher is the only producer and _shutdown_threads joins
+            # it FIRST, then posts the None sentinel below, so this
+            # get() always ends with work or the shutdown sentinel
+            # dklint: ignore[unbounded-wait] sentinel-terminated park; batcher joined before sentinels by _shutdown_threads
             item = rep.inbox.get()
             if item is None:
                 break

@@ -688,6 +688,627 @@ def test_jit_impure(tmp_path):
         (9, True), (12, True)]
 
 
+# -- concurrency pass (round 15) ---------------------------------------
+
+THREADS_FIXTURE = """
+    KNOWN_THREAD_ROOTS = {
+        "work.loop": "w.py:Worker._loop",
+    }
+    LOCK_ORDER = ()
+"""
+
+
+def test_thread_root_unknown_and_clean(tmp_path):
+    worker = """
+        import threading
+
+
+        class Worker:
+            def _loop(self):
+                pass
+
+            def _rogue(self):
+                pass
+
+            def start(self):
+                threading.Thread(target=self.{target}).start()
+    """
+    fs = lint(tmp_path / "bad", {
+        "analysis/threads.py": THREADS_FIXTURE,
+        "w.py": worker.format(target="_rogue")},
+        rules=["thread-root-unknown"])
+    assert [f.rule for f in fs] == ["thread-root-unknown"]
+    assert "w.py:Worker._rogue" in fs[0].message
+    fs = lint(tmp_path / "ok", {
+        "analysis/threads.py": THREADS_FIXTURE,
+        "w.py": worker.format(target="_loop")},
+        rules=["thread-root-unknown", "thread-root-unused"])
+    assert fs == []
+
+
+def test_thread_root_dynamic_needs_annotation(tmp_path):
+    files = {
+        "analysis/threads.py": THREADS_FIXTURE,
+        "w.py": """
+            import threading
+
+
+            class Worker:
+                def _loop(self):
+                    pass
+
+
+            def spawn(fn):
+                threading.Thread(target=fn).start()
+        """}
+    fs = lint(tmp_path / "bad", files, rules=["thread-root-unknown"])
+    assert [f.rule for f in fs] == ["thread-root-unknown"]
+    assert "computed" in fs[0].message
+    files["w.py"] = """
+        import threading
+
+
+        class Worker:
+            def _loop(self):
+                pass
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+
+        def spawn(fn):
+            # dklint: thread-root=work.loop
+            threading.Thread(target=fn).start()
+    """
+    fs = lint(tmp_path / "ok", files,
+              rules=["thread-root-unknown", "thread-root-unused"])
+    assert fs == []
+
+
+def test_thread_root_unused_and_tilde_rows(tmp_path):
+    reg = """
+        KNOWN_THREAD_ROOTS = {
+            "work.loop": "w.py:Worker._loop",
+            "ghost.loop": "w.py:Worker._ghost",
+            "http.handler": "~w.py:Handler.*",
+            "http.phantom": "~w.py:Phantom.*",
+        }
+    """
+    fs = lint(tmp_path, {
+        "analysis/threads.py": reg,
+        "w.py": """
+            import threading
+
+
+            class Handler:
+                def do_GET(self):
+                    pass
+
+
+            class Worker:
+                def _loop(self):
+                    pass
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+        """}, rules=["thread-root-unused"])
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert any("ghost.loop" in m for m in msgs)       # dead plain row
+    assert any("http.phantom" in m for m in msgs)     # ~row to nothing
+    # the resolvable ~row (Handler.*) and the matched plain row are fine
+    assert not any("http.handler" in m or "work.loop" in m
+                   for m in msgs)
+
+
+def test_signal_registration_is_inventoried(tmp_path):
+    fs = lint(tmp_path, {
+        "analysis/threads.py": """
+            KNOWN_THREAD_ROOTS = {
+                "sig.handler": "p.py:_handler",
+            }
+        """,
+        "p.py": """
+            import signal
+
+
+            def _handler(signum, frame):
+                pass
+
+
+            def _unlisted(signum, frame):
+                pass
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _handler)
+                signal.signal(signal.SIGINT, _unlisted)
+                signal.signal(signal.SIGUSR1, signal.SIG_DFL)  # not a root
+        """}, rules=["thread-root-unknown", "thread-root-unused"])
+    assert [f.rule for f in fs] == ["thread-root-unknown"]
+    assert "p.py:_unlisted" in fs[0].message
+
+
+LOCK_PAIR = """
+    import threading
+
+
+    class A:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+
+        def one(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+
+        def two(self):
+            with self._lock_b:
+                {body}
+"""
+
+
+def test_lock_order_cycle(tmp_path):
+    fs = lint(tmp_path / "bad",
+              {"locks.py": LOCK_PAIR.format(
+                  body="with self._lock_a:\n                    pass")},
+              rules=["lock-order-cycle"])
+    assert [f.rule for f in fs] == ["lock-order-cycle"]
+    assert "_lock_a" in fs[0].message and "_lock_b" in fs[0].message
+    fs = lint(tmp_path / "ok",
+              {"locks.py": LOCK_PAIR.format(body="pass")},
+              rules=["lock-order-cycle"])
+    assert fs == []
+
+
+def test_lock_order_declared_ordering_convicts_inversion(tmp_path):
+    """LOCK_ORDER declares a_before_b ONCE; code that only ever
+    acquires a under b closes a cycle through the declaration."""
+    files = {
+        "analysis/threads.py": """
+            KNOWN_THREAD_ROOTS = {}
+            LOCK_ORDER = (
+                ("locks.py:A._lock_a", "locks.py:A._lock_b"),
+            )
+        """,
+        "locks.py": """
+            import threading
+
+
+            class A:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+                    self._lock_b = threading.Lock()
+
+                def two(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """}
+    fs = lint(tmp_path, files, rules=["lock-order-cycle"])
+    assert [f.rule for f in fs] == ["lock-order-cycle"]
+
+
+def test_lock_order_declaration_must_name_real_locks(tmp_path):
+    """A LOCK_ORDER entry naming no registered lock declares nothing —
+    it is flagged instead of rotting silently."""
+    fs = lint(tmp_path, {
+        "analysis/threads.py": """
+            KNOWN_THREAD_ROOTS = {}
+            LOCK_ORDER = (
+                ("locks.py:A._lock_a", "locks.py:A._gone"),
+            )
+        """,
+        "locks.py": """
+            import threading
+
+
+            class A:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+        """}, rules=["lock-order-cycle"])
+    assert len(fs) == 1 and "_gone" in fs[0].message
+
+
+def test_lock_order_reentrant_self_nesting_ok(tmp_path):
+    src = """
+        import threading
+
+
+        class R:
+            def __init__(self):
+                self._state_{kind} = threading.{ctor}()
+
+            def outer(self):
+                with self._state_{kind}:
+                    self.inner()
+
+            def inner(self):
+                with self._state_{kind}:
+                    pass
+    """
+    fs = lint(tmp_path / "rlock",
+              {"r.py": src.format(kind="rlock", ctor="RLock")},
+              rules=["lock-order-cycle"])
+    assert fs == []  # RLock may self-nest
+    fs = lint(tmp_path / "lock",
+              {"r.py": src.format(kind="lock", ctor="Lock")},
+              rules=["lock-order-cycle"])
+    assert len(fs) == 1  # a plain Lock self-nest IS a deadlock
+
+
+SHARED_WRITE = """
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = None
+
+        def _loop(self):
+            {thread_write}
+
+        def start(self):
+            threading.Thread(target=self._loop).start()
+
+        def poke(self):
+            {main_write}
+"""
+
+
+def test_unguarded_shared_write(tmp_path):
+    fs = lint(tmp_path, {"shared.py": SHARED_WRITE.format(
+        thread_write="self.state = 1",
+        main_write="self.state = 2")},
+        rules=["unguarded-shared-write"])
+    assert [f.rule for f in fs] == ["unguarded-shared-write"] * 2
+    assert "Worker._loop" in fs[0].message \
+        or "shared.py:Worker._loop" in fs[0].message
+
+
+def test_shared_write_common_lock_is_clean(tmp_path):
+    guarded = "with self._lock:\n                self.state = {v}"
+    fs = lint(tmp_path, {"shared.py": SHARED_WRITE.format(
+        thread_write=guarded.format(v=1),
+        main_write=guarded.format(v=2))},
+        rules=["unguarded-shared-write"])
+    assert fs == []
+
+
+def test_shared_write_sync_primitive_exempt(tmp_path):
+    fs = lint(tmp_path, {"shared.py": """
+        import threading
+
+
+        class Worker:
+            def __init__(self):
+                self.done = threading.Event()
+
+            def _loop(self):
+                self.done = threading.Event()
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def poke(self):
+                self.done = threading.Event()
+    """}, rules=["unguarded-shared-write"])
+    assert fs == []
+
+
+def test_shared_write_init_only_main_is_clean(tmp_path):
+    """__init__ writes are pre-thread; a thread that only READS the
+    attribute afterwards is the hot-reload pattern, not a finding."""
+    fs = lint(tmp_path, {"shared.py": SHARED_WRITE.format(
+        thread_write="x = self.state",
+        main_write="y = self.state")},
+        rules=["unguarded-shared-write"])
+    assert fs == []
+
+
+def test_shared_write_helper_inherits_callers_lock(tmp_path):
+    """A helper ALWAYS called under the lock is guarded (intersection
+    over its call sites, to a fixpoint)."""
+    fs = lint(tmp_path, {"shared.py": """
+        import threading
+
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = None
+
+            def _set(self, v):
+                self.state = v
+
+            def _loop(self):
+                with self._lock:
+                    self._set(1)
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def poke(self):
+                with self._lock:
+                    self._set(2)
+    """}, rules=["unguarded-shared-write"])
+    assert fs == []
+
+
+def test_shared_write_waiver(tmp_path):
+    fs = lint(tmp_path, {"shared.py": SHARED_WRITE.format(
+        thread_write="self.state = 1",
+        main_write="# dklint: ignore[unguarded-shared-write] "
+                   "reference assignment is atomic; readers tolerate "
+                   "either value\n            self.state = 2")},
+        rules=["unguarded-shared-write"])
+    # only the un-waived thread-side write remains
+    assert len(fs) == 1 and "self.state = 1" in fs[0].key
+
+
+def test_unbounded_wait(tmp_path):
+    fs = lint(tmp_path, {"waits.py": """
+        def bad(t, ev, cv, fut, lock):
+            t.join()
+            ev.wait()
+            cv.wait_for(lambda: True)
+            fut.result()
+            lock.acquire()
+
+
+        def good(t, ev, cv, fut, lock):
+            t.join(5.0)
+            ev.wait(timeout=2.0)
+            cv.wait_for(lambda: True, timeout=1.0)
+            fut.result(timeout=5)
+            lock.acquire(timeout=1)
+            ", ".join(["strings", "are", "not", "threads"])
+    """}, rules=["unbounded-wait"])
+    assert [f.rule for f in fs] == ["unbounded-wait"] * 5
+    assert [f.line for f in fs] == [3, 4, 5, 6, 7]  # bad()'s body only
+
+
+def test_unbounded_queue_get(tmp_path):
+    """A zero-arg `.get()` on a queue-shaped receiver is an unbounded
+    cross-thread park (dict/env `.get` always passes a key, so it
+    never matches); a timeout bounds it."""
+    fs = lint(tmp_path, {"q.py": """
+        def worker(inbox, cfg):
+            item = inbox.get()
+            bounded = inbox.get(timeout=5.0)
+            not_a_queue = cfg.get("key")
+    """}, rules=["unbounded-wait"])
+    assert [(f.rule, f.line) for f in fs] == [("unbounded-wait", 3)]
+    assert "queue" in fs[0].message
+
+
+def test_unbounded_wait_waiver(tmp_path):
+    fs = lint(tmp_path, {"waits.py": """
+        def idle_park(cv):
+            # dklint: ignore[unbounded-wait] every producer notifies
+            cv.wait()
+    """}, rules=["unbounded-wait"])
+    assert fs == []
+
+
+def test_blocking_under_lock(tmp_path):
+    fs = lint(tmp_path, {"block.py": """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+
+        def direct():
+            with _lock:
+                time.sleep(1.0)
+
+
+        def helper():
+            time.sleep(0.1)
+
+
+        def via_call():
+            with _lock:
+                helper()
+
+
+        def fine():
+            with _lock:
+                pass
+            time.sleep(0.5)
+    """}, rules=["blocking-under-lock"])
+    assert [f.rule for f in fs] == ["blocking-under-lock"] * 2
+    assert "time.sleep" in fs[0].message      # the direct sleep
+    assert "helper" in fs[1].message          # via the call graph
+    assert fs[0].line < fs[1].line
+
+
+def test_fault_point_is_blocking_under_lock(tmp_path):
+    """A chaos `delay` action turns any fault_point into a sleep — the
+    call is banned under a registered lock."""
+    fs = lint(tmp_path, {"block.py": """
+        import threading
+
+        from faults import fault_point
+
+        _lock = threading.Lock()
+
+
+        def guarded():
+            with _lock:
+                fault_point("x.y")
+    """}, rules=["blocking-under-lock"])
+    assert len(fs) == 1 and "fault_point" in fs[0].message
+
+
+def test_unused_waiver(tmp_path):
+    fs = lint(tmp_path, {"x.py": """
+        def stale():
+            # dklint: ignore[broad-except] nothing broad left below
+            return 1
+
+
+        def active():
+            try:
+                work()
+            # dklint: ignore[broad-except] best-effort
+            except Exception:
+                pass
+    """}, rules=["unused-waiver"])
+    assert [f.rule for f in fs] == ["unused-waiver"]
+    assert fs[0].line == 3 and "broad-except" in fs[0].message
+
+
+def test_unused_waiver_is_itself_waivable(tmp_path):
+    fs = lint(tmp_path, {"x.py": """
+        def stale():
+            # dklint: ignore[unused-waiver] kept deliberately for the
+            # next refactor wave
+            # dklint: ignore[broad-except] nothing broad left below
+            return 1
+    """}, rules=["unused-waiver"])
+    assert fs == []
+
+
+def test_waiver_in_docstring_is_not_a_waiver(tmp_path):
+    """Waivers live in real comments (tokenize), never in docstrings:
+    docs that MENTION ignore[...] must neither waive a finding below
+    them nor trip the unused-waiver sweep."""
+    fs = lint(tmp_path, {"x.py": '''
+        def documented():
+            """Waive with `# dklint: ignore[broad-except] reason`."""
+            try:
+                work()
+            except Exception:
+                pass
+    '''}, rules=["broad-except", "unused-waiver"])
+    assert [f.rule for f in fs] == ["broad-except"]
+
+
+def test_rules_table_doc_sync(tmp_path):
+    from dist_keras_tpu.analysis.core import rules_table
+
+    fs = lint(tmp_path, {"x.py": "a = 1\n"},
+              readme="no marked tables here\n",
+              rules=["rule-undocumented", "rule-doc-drift"])
+    assert [f.rule for f in fs] == ["rule-undocumented"]
+    assert "marker" in fs[0].message
+
+    good = ("<!-- dklint: rules-table -->\n" + rules_table() + "\n")
+    fs = lint(tmp_path, {"x.py": "a = 1\n"}, readme=good,
+              rules=["rule-undocumented", "rule-doc-drift"])
+    assert fs == []
+
+    stale = good.replace(
+        "| `syntax-error` |", "| `syntax-error` | STALE |", 1)
+    fs = lint(tmp_path, {"x.py": "a = 1\n"}, readme=stale,
+              rules=["rule-undocumented", "rule-doc-drift"])
+    assert rules_of(fs) == ["rule-doc-drift", "rule-undocumented"]
+
+
+def test_rules_table_cli(capsys):
+    from dist_keras_tpu.analysis.core import rules_table
+
+    rc = dklint_main(["--rules-table"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.strip() == rules_table().strip()
+    for rule in RULES:
+        assert f"`{rule}`" in out
+
+
+# -- analyzer CLI composition with the concurrency pass ----------------
+
+def test_rules_filter_concurrency_in_and_out(tmp_path):
+    """--rules slices the concurrency rules in and out like any other
+    pass's (and never silently drops syntax-error)."""
+    (tmp_path / "waits.py").write_text(textwrap.dedent("""
+        def bad(t):
+            t.join()
+        try:
+            work()
+        except Exception:
+            pass
+    """))
+    fs = run_analysis(str(tmp_path), rules=["unbounded-wait"])
+    assert [f.rule for f in fs] == ["unbounded-wait"]
+    fs = run_analysis(str(tmp_path), rules=["broad-except"])
+    assert [f.rule for f in fs] == ["broad-except"]
+    fs = run_analysis(str(tmp_path),
+                      rules=["unbounded-wait", "broad-except"])
+    assert rules_of(fs) == ["broad-except", "unbounded-wait"]
+
+
+def test_write_baseline_grandfathers_concurrency_finding(
+        tmp_path, capsys):
+    """--write-baseline grandfathers a seeded concurrency finding, and
+    the new fingerprint keys are stable under line shifts."""
+    src = textwrap.dedent("""
+        def bad(t):
+            t.join()
+    """)
+    (tmp_path / "waits.py").write_text(src)
+    baseline = tmp_path / "bl.json"
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--baseline", str(baseline),
+                      "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    fps = load_baseline(str(baseline))
+    assert any(fp.startswith("unbounded-wait::waits.py::")
+               for fp in fps)
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+
+    # unrelated lines above shift the site; the fingerprint holds
+    (tmp_path / "waits.py").write_text(
+        "# a new comment\n# another\n\n" + src)
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+
+    # a NEW unbounded wait in another function is not masked
+    (tmp_path / "waits.py").write_text(src + textwrap.dedent("""
+        def worse(ev):
+            ev.wait()
+    """))
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "unbounded-wait" in out
+
+
+def test_json_reports_pass_seconds(tmp_path, capsys):
+    (tmp_path / "x.py").write_text("a = 1\n")
+    rc = dklint_main(["--root", str(tmp_path), "--no-readme",
+                      "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    secs = doc["pass_seconds"]
+    assert set(secs) >= {"load", "registries", "purity", "hygiene",
+                         "concurrency", "waivers"}
+    assert all(isinstance(v, float) for v in secs.values())
+
+
+def test_analyzer_runtime_budget():
+    """The real-tree analysis (all passes, incl. the cross-module
+    graph walks) must stay fast enough to live inside tier-1: budget
+    20 s wall on this image (observed ~2 s; the margin absorbs CI
+    contention, not algorithmic regressions)."""
+    timings = {}
+    run_analysis(PKG, readme=os.path.join(REPO, "README.md"),
+                 timings=timings)
+    total = sum(timings.values())
+    assert total < 20.0, f"analyzer took {total:.1f}s: {timings}"
+    assert timings.get("concurrency", 0.0) > 0.0
+
+
 # -- baseline + CLI ----------------------------------------------------
 
 def test_baseline_grandfathers_then_catches_new(tmp_path):
@@ -799,7 +1420,12 @@ def test_rule_docs_complete():
         "metric-unregistered", "metric-dynamic", "metric-collision",
         "metric-undocumented", "metric-doc-drift", "signal-unsafe",
         "obs-must-not-raise", "broad-except", "untyped-raise",
-        "jit-impure"}
+        "jit-impure",
+        # round 15: the concurrency pass + doc/waiver hygiene
+        "thread-root-unknown", "thread-root-unused",
+        "lock-order-cycle", "unguarded-shared-write",
+        "unbounded-wait", "blocking-under-lock", "unused-waiver",
+        "rule-undocumented", "rule-doc-drift"}
 
 
 def test_real_tree_is_clean_with_shipped_baseline():
