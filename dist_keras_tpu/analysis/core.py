@@ -90,6 +90,14 @@ RULES = {
     "metric-doc-drift":
         "the README metrics table names a metric that is not in "
         "metrics.KNOWN_METRICS",
+    "span-unregistered":
+        "a span(...)/span_at(...) call site names a span missing from "
+        "spans.KNOWN_SPANS (the report, the Perfetto export and "
+        "operator tooling treat the registry as the closed phase "
+        "vocabulary)",
+    "span-dynamic":
+        "a span call with a computed name lacks a "
+        "`# dklint: spans=<registered name or pattern>` annotation",
     "signal-unsafe":
         "a lock acquisition, event emission or blocking I/O call is "
         "reachable from a registered signal handler (handlers run "

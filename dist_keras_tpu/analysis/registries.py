@@ -22,6 +22,11 @@ like the real one):
   registered pattern), pairwise collision-freedom of the registered
   names after Prometheus sanitization, and the README metrics table
   (``<!-- dklint: metrics-table -->``) both ways.
+- ``spans.KNOWN_SPANS``  <->  every ``span("name")`` /
+  ``span_at("name", ...)`` call site (wildcard entries match via
+  fnmatch; dynamic names annotate ``# dklint: spans=<pattern>``) —
+  the span vocabulary the report, the Perfetto export and operator
+  tooling attribute against is registry-closed like the others.
 """
 
 from __future__ import annotations
@@ -102,7 +107,7 @@ def _extract_dict_assign(sf, target_name):
 
 def _extract_registries(project):
     regs = {"faults": None, "events": None, "metrics": None,
-            "knobs": None}
+            "knobs": None, "spans": None}
     for sf in project.files:
         if regs["faults"] is None:
             found = _extract_tuple_assign(sf, "KNOWN_POINTS")
@@ -112,6 +117,10 @@ def _extract_registries(project):
             found = _extract_tuple_assign(sf, "KNOWN_EVENTS")
             if found:
                 regs["events"] = (found[0], sf, found[1])
+        if regs["spans"] is None:
+            found = _extract_tuple_assign(sf, "KNOWN_SPANS")
+            if found:
+                regs["spans"] = (found[0], sf, found[1])
         if regs["metrics"] is None:
             found = _extract_dict_assign(sf, "KNOWN_METRICS")
             if found:
@@ -266,6 +275,7 @@ def run(project):
     event_reg = regs["events"]
     metric_reg = regs["metrics"]
     knob_reg = regs["knobs"]
+    span_reg = regs["spans"]
 
     fault_points = set(fault_reg[0]) if fault_reg else None
     event_names = set(event_reg[0]) if event_reg else None
@@ -274,6 +284,14 @@ def run(project):
                         if "*" in n} if metric_names else {})
     knob_names = ({entry[0] for entry in knob_reg[0]} if knob_reg
                   else None)
+    span_names = set(span_reg[0]) if span_reg else None
+    span_patterns = ([n for n in span_names if "*" in n]
+                     if span_names else [])
+
+    def span_known(name):
+        return (name in span_names
+                or any(fnmatch.fnmatchcase(name, p)
+                       for p in span_patterns))
 
     used_fault_points = set()
 
@@ -285,6 +303,9 @@ def run(project):
     for sf in project.files:
         defines_fault_point = any(
             isinstance(n, ast.FunctionDef) and n.name == "fault_point"
+            for n in ast.walk(sf.tree))
+        defines_span = any(
+            isinstance(n, ast.FunctionDef) and n.name == "span"
             for n in ast.walk(sf.tree))
         for node in ast.walk(sf.tree):
             if not isinstance(node, (ast.Call, ast.Subscript,
@@ -423,6 +444,37 @@ def run(project):
                                     f"registered as a {kind}, not a "
                                     f"{attr}",
                                     key=f"metric-kind:{pat}")
+
+            # span("name") / span_at("name", ...) call sites — the
+            # span vocabulary is registry-closed like events/metrics
+            # (the defining module's own internals are exempt)
+            if attr in ("span", "span_at") and span_names is not None \
+                    and not defines_span and node.args:
+                name = _str_const(node.args[0])
+                if name is not None:
+                    if not span_known(name):
+                        emit_finding(
+                            "span-unregistered", sf, node.lineno,
+                            f"span {name!r} is not in "
+                            "spans.KNOWN_SPANS",
+                            key=f"span:{name}")
+                else:
+                    declared = sf.annotation("spans", node.lineno)
+                    if declared is None:
+                        emit_finding(
+                            "span-dynamic", sf, node.lineno,
+                            "span with a computed name needs "
+                            "`# dklint: spans=<registered name or "
+                            "pattern>`")
+                    else:
+                        for pat in declared:
+                            if pat not in span_names:
+                                emit_finding(
+                                    "span-unregistered", sf,
+                                    node.lineno,
+                                    f"annotated span {pat!r} is not a "
+                                    "registered KNOWN_SPANS entry",
+                                    key=f"span:{pat}")
 
     # registry -> call-site direction for fault points
     if fault_reg is not None:

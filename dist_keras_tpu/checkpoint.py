@@ -541,7 +541,8 @@ class Checkpointer:
         # condition; _async_error is the last background failure not
         # yet surfaced to the caller (re-raised at the next save/drain).
         self._async_cv = threading.Condition()
-        self._async_pending = None  # (handle, step, state, specs, rank, world)
+        self._async_pending = None  # (handle, step, state, specs,
+        #                              rank, world, trace_ctx)
         self._async_active = None   # handle currently being written
         self._async_thread = None
         self._async_error = None
@@ -828,6 +829,12 @@ class Checkpointer:
             return AsyncSaveHandle(step, status="committed")
         self._raise_async_error()
         handle = AsyncSaveHandle(step)
+        # capture the saving thread's trace context NOW: the background
+        # writer resumes it, so the ckpt.save span it opens parents into
+        # the trainer's trace across the snapshot->write thread handoff
+        from dist_keras_tpu.observability import spans as _spans
+
+        trace_ctx = _spans.capture()
         deadline = None
         if world > 1:
             # ONE shared deadline for the whole backpressure wait: the
@@ -894,7 +901,7 @@ class Checkpointer:
                                 by=step)
                     self._async_pending = None  # slot taken over
             self._async_pending = (handle, step, state, shard_specs,
-                                   rank, world)
+                                   rank, world, trace_ctx)
             self._ensure_writer()
             self._async_cv.notify_all()
         stall = _time.perf_counter() - t0
@@ -973,11 +980,16 @@ class Checkpointer:
                 # wake a pod-mode save() backpressured on the pending
                 # slot (promotion may take the whole marker wait)
                 self._async_cv.notify_all()
-            handle, step, state, specs, rank, world = job
+            handle, step, state, specs, rank, world, trace_ctx = job
             exc = None
             completed = False
             try:
-                self._save_sync(step, state, rank, world, specs)
+                # resume the saving thread's trace: the ckpt.save span
+                # below joins the trainer's trace across the handoff
+                from dist_keras_tpu.observability import spans as _spans
+
+                with _spans.resume(trace_ctx):
+                    self._save_sync(step, state, rank, world, specs)
                 completed = True
             # dklint: ignore[broad-except] the handle carries the typed
             # error to whoever waits; _async_error re-raises it at the

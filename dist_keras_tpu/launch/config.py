@@ -69,6 +69,10 @@ class JobConfig:
     # DK_OBS_SAMPLE_S — the MetricsSampler/watchdog cadence in seconds
     metrics_port: int | None = None
     obs_sample_s: float | None = None
+    # job-wide trace id (32 hex chars), exported as DK_TRACE_ID with
+    # the event log so every host's root spans join one trace; None =
+    # Job mints one (deterministic under DK_TRACE_SEED)
+    trace_id: str | None = None
     # launcher-side auto-resume (resilience.supervisor): an int arms
     # Job.supervise_run() with that many whole-pod relaunch waves per
     # rolling 600 s window (true = the default budget of 3); a dict
@@ -92,6 +96,7 @@ class JobConfig:
               "serve_port": (int, type(None)),
               "metrics_port": (int, type(None)),
               "obs_sample_s": (int, float, type(None)),
+              "trace_id": (str, type(None)),
               "supervise": (int, bool, dict, type(None))}
 
     @classmethod

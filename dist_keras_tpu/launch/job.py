@@ -113,7 +113,7 @@ class Job:
                  retries=2, retry_backoff=0.5, launch_retries=0,
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
                  serve_port=None, supervise=None, metrics_port=None,
-                 obs_sample_s=None):
+                 obs_sample_s=None, trace_id=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -202,6 +202,21 @@ class Job:
                              else int(metrics_port))
         self.obs_sample_s = (None if obs_sample_s is None
                              else float(obs_sample_s))
+        # trace_id: the job-wide trace identity exported as DK_TRACE_ID
+        # alongside the event log — every host's root spans join it, so
+        # the merged timeline stitches the whole pod into ONE trace.
+        # Minted here (deterministically under DK_TRACE_SEED) unless
+        # the operator passes an explicit id to correlate with an
+        # outer system's trace.
+        if trace_id is None:
+            from dist_keras_tpu.observability import spans
+
+            trace_id = spans.new_trace_id()
+        if not re.match(r"^[0-9a-f]{32}$", str(trace_id)):
+            raise ValueError(
+                f"trace_id {trace_id!r} must be 32 lowercase hex chars "
+                "(the traceparent trace-id shape)")
+        self.trace_id = str(trace_id)
         # supervise: arm supervise_run()'s pod-relaunch budget.
         # int N = N relaunch WAVES per rolling 600 s window; a dict
         # gives the full knobs {"max_restarts", "budget_window_s",
@@ -315,8 +330,11 @@ class Job:
         if self.obs_dir:
             # telemetry plane (observability): each host's event log
             # lands in <obs_dir>/events-rank_{pid}.jsonl (the writer
-            # reads its rank from DK_COORD_RANK / JAX_PROCESS_ID)
+            # reads its rank from DK_COORD_RANK / JAX_PROCESS_ID).
+            # DK_TRACE_ID rides along: every host's root spans join the
+            # job's trace, so the pod's merged timeline is ONE trace.
             env["DK_OBS_DIR"] = str(self.obs_dir)
+            env["DK_TRACE_ID"] = self.trace_id
         if self.serve_port is not None:
             # serving plane: ServingServer(port=None) binds this
             env["DK_SERVE_PORT"] = str(self.serve_port)

@@ -17,9 +17,20 @@ the host and phase that stalled instead of silence:
 - :mod:`~dist_keras_tpu.observability.metrics` — process-wide named
   counters/gauges/histograms (the grown-up ``StepTimer``, which is now a
   thin wrapper); snapshots ride the event stream at epoch boundaries.
-- :mod:`~dist_keras_tpu.observability.spans` — nested ``span(name)``
-  regions stamped into the event log and forwarded to
-  ``jax.profiler.TraceAnnotation`` while a device trace is active.
+- :mod:`~dist_keras_tpu.observability.spans` — distributed tracing:
+  nested ``span(name)`` regions minting ``trace_id``/``span_id``/
+  ``parent_id``, capturable/resumable across threads, propagated
+  cross-process via a ``traceparent`` header and the ``DK_TRACE_ID``
+  env; forwarded to ``jax.profiler.TraceAnnotation`` while a device
+  trace is active.
+- :mod:`~dist_keras_tpu.observability.flight` — crash-safe flight
+  recorder: a bounded ring of recent records, dumped to ``DK_OBS_DIR``
+  on watchdog alerts, preemption, unhandled crash, or ``/tracez``.
+- :mod:`~dist_keras_tpu.observability.trace_export` — Chrome
+  trace-event (Perfetto-loadable) export + per-trace connectivity
+  report; CLI ``--perfetto`` / ``--traces`` / ``--dumps``.
+- :mod:`~dist_keras_tpu.observability.statusz` — the shared
+  ``/statusz`` build/config/open-span renderer both HTTP servers serve.
 - :mod:`~dist_keras_tpu.observability.report` — merge per-host logs
   into one (time, rank)-ordered timeline with per-phase summaries;
   also the CLI: ``python -m dist_keras_tpu.observability <dir>``
@@ -68,9 +79,12 @@ from dist_keras_tpu.observability.spans import span
 # checkpoint/faults/retry — and must not pay for numpy rule math or
 # http.server unless it actually arms the sampler or an exporter
 _LAZY = {
+    "flight": "dist_keras_tpu.observability.flight",
     "perf": "dist_keras_tpu.observability.perf",
     "prometheus": "dist_keras_tpu.observability.prometheus",
+    "statusz": "dist_keras_tpu.observability.statusz",
     "timeseries": "dist_keras_tpu.observability.timeseries",
+    "trace_export": "dist_keras_tpu.observability.trace_export",
     "watchdog": "dist_keras_tpu.observability.watchdog",
     "Exporter": ("dist_keras_tpu.observability.prometheus", "Exporter"),
     "MetricsSampler": ("dist_keras_tpu.observability.timeseries",
@@ -98,8 +112,8 @@ def __dir__():
     return sorted(set(globals()) | set(_LAZY))
 
 __all__ = [
-    "events", "metrics", "perf", "prometheus", "report", "spans",
-    "timeseries", "watchdog",
+    "events", "flight", "metrics", "perf", "prometheus", "report",
+    "spans", "statusz", "timeseries", "trace_export", "watchdog",
     "EventWriter", "emit", "enabled", "obs_dir",
     "counter", "gauge", "histogram", "snapshot", "emit_snapshot",
     "to_prometheus", "span",

@@ -11,6 +11,18 @@
   # data/step/comm/ckpt host-wall breakdown, watchdog alerts
   python -m dist_keras_tpu.observability /path/to/obs_dir --perf
 
+  # tracing: stitch the multi-host timeline into Perfetto-loadable
+  # Chrome trace JSON (open at ui.perfetto.dev), or summarize trace
+  # connectivity per trace_id
+  python -m dist_keras_tpu.observability /path/to/obs_dir \
+      --perfetto trace.json [--trace <trace_id>]
+  python -m dist_keras_tpu.observability /path/to/obs_dir --traces
+  # --dumps sources records from the flight-recorder dumps
+  # (flightrec-*.json) instead of the event log — the crash-time tail
+  # when the run died before flushing its log
+  python -m dist_keras_tpu.observability /path/to/obs_dir \
+      --dumps --perfetto crash.json
+
 Point it at the directory a run exported as ``DK_OBS_DIR`` (for a pod
 job launched with ``Job(obs_dir=...)``, the launcher's
 ``collect_obs(dest)`` rsyncs every host's directory back first).
@@ -48,9 +60,40 @@ def main(argv=None):
                          "data/step/comm/ckpt host-wall breakdown, "
                          "and every watchdog alert in the timeline "
                          "(with --json: a 'perf' key on the summary)")
+    ap.add_argument("--perfetto", metavar="PATH",
+                    help="write the merged timeline as Chrome trace-"
+                         "event JSON (Perfetto-loadable) to PATH")
+    ap.add_argument("--dumps", action="store_true",
+                    help="source records from the flight-recorder "
+                         "dumps (flightrec-*.json, deduplicated and "
+                         "stitched across hosts) instead of the "
+                         "event log")
+    ap.add_argument("--trace", metavar="TRACE_ID",
+                    help="restrict --perfetto to one trace id")
+    ap.add_argument("--traces", action="store_true",
+                    help="print the per-trace connectivity summary "
+                         "(roots, orphans, thread/host handoffs)")
     args = ap.parse_args(argv)
 
-    events = report.read_events(args.obs_dir)
+    if args.dumps:
+        from dist_keras_tpu.observability import flight
+
+        events = flight.read_dumps(args.obs_dir)
+    else:
+        events = report.read_events(args.obs_dir)
+
+    if args.perfetto or args.traces:
+        from dist_keras_tpu.observability import trace_export
+
+        if args.perfetto:
+            n = trace_export.write_chrome_trace(
+                args.perfetto, events, trace_id=args.trace)
+            print(f"wrote {n} trace events to {args.perfetto} "
+                  "(open at ui.perfetto.dev)")
+        if args.traces:
+            print(trace_export.render_traces(events))
+        return 0 if events else 1
+
     if args.json:
         doc = events if args.raw else report.summarize(events)
         if args.perf and not args.raw:

@@ -64,6 +64,17 @@ _lock = threading.Lock()
 _resolved = False      # has the DK_OBS_DIR decision been made?
 _writer = None         # EventWriter when enabled, None when disabled
 _warned = False        # one dropped-event warning per process
+_ctx_provider = None   # spans.py: current trace identity per thread
+_sink = None           # flight.py: in-memory ring copy of each record
+
+
+def _set_context_provider(fn):
+    """Register the trace-context provider (``spans._current_ids``):
+    every emitted event is stamped with the current thread's open-span
+    trace identity via ``setdefault`` — so breadcrumb events stitch
+    into the span tree without their seams knowing about tracing."""
+    global _ctx_provider
+    _ctx_provider = fn
 
 # The event vocabulary — every ``kind`` any seam emits (including the
 # repo-root ``bench.py`` driver's).  Adding an emit("...") call site?
@@ -96,7 +107,7 @@ KNOWN_EVENTS = (
     "serve_drain",
     # telemetry plane (observability/)
     "perf_sample", "watchdog_alert", "watchdog_clear",
-    "metrics_exporter_listen",
+    "metrics_exporter_listen", "flight_dump",
     # bench driver (repo-root bench.py)
     "bench_probe_begin", "bench_probe_end", "bench_config_begin",
     "bench_config_end", "bench_config_skipped", "bench_complete",
@@ -185,7 +196,8 @@ class EventWriter:
             pass
 
     def emit(self, kind, **fields):
-        """Write one event line.  Raises on failure — the module-level
+        """Write one event line; -> the record dict (the flight
+        recorder's ring copy).  Raises on failure — the module-level
         :func:`emit` is the never-throws wrapper."""
         with self._lock:
             seq = self._seq
@@ -202,7 +214,7 @@ class EventWriter:
             os.write(self._fd, line)
             if self.fsync:
                 os.fsync(self._fd)
-            return
+            return record
         # size-capped log: the write, the size check and a possible
         # rotation must be one unit, or a concurrent writer could emit
         # into a just-retired fd
@@ -213,6 +225,7 @@ class EventWriter:
             self._bytes += len(line)
             if self._bytes >= self.rotate_bytes:
                 self._rotate()
+        return record
 
     def close(self):
         try:
@@ -236,6 +249,16 @@ def _resolve():
                            f"{directory!r}: {e!r}")
                 _writer = None
         _resolved = True
+    if _writer is not None:
+        try:
+            # the flight recorder rides the same DK_OBS_DIR gate: it
+            # rings a copy of every record and arms the crash hooks
+            from dist_keras_tpu.observability import flight
+
+            flight.attach()
+        # dklint: ignore[broad-except] the recorder is best-effort; the event log must come up without it
+        except Exception as e:  # pragma: no cover - recorder optional
+            _warn_once(f"flight recorder unavailable: {e!r}")
 
 
 def _warn_once(msg):
@@ -285,9 +308,18 @@ def emit(kind, **fields):
     if w is None:
         return
     try:
+        prov = _ctx_provider
+        if prov is not None:
+            ctx = prov()
+            if ctx:
+                for k, v in ctx.items():
+                    fields.setdefault(k, v)
         # dklint: ignore[event-dynamic] pure forwarder: the literal
         # kind is checked at every emit() call site, not here
-        w.emit(kind, **fields)
+        rec = w.emit(kind, **fields)
+        sink = _sink
+        if sink is not None:
+            sink(rec)
     # dklint: ignore[broad-except] the never-throws emit contract: dropped event + one warning
     except Exception as e:
         _warn_once(f"event emit failed ({kind}): {e!r}")
@@ -295,11 +327,13 @@ def emit(kind, **fields):
 
 def reset():
     """Close the writer and forget the cached ``DK_OBS_DIR`` decision —
-    tests that flip the env need a fresh resolution."""
-    global _resolved, _writer, _warned
+    tests that flip the env need a fresh resolution.  The flight-
+    recorder sink detaches too (re-attached at the next resolution)."""
+    global _resolved, _writer, _warned, _sink
     with _lock:
         if _writer is not None:
             _writer.close()
         _writer = None
         _resolved = False
         _warned = False
+        _sink = None

@@ -1,0 +1,273 @@
+"""Flight recorder — a crash-safe ring of the most recent span/event
+records, dumped to ``DK_OBS_DIR`` when something goes wrong.
+
+The JSONL event log answers "what happened over the whole run"; the
+flight recorder answers the incident question — "what were the last N
+things this process did" — and guarantees that answer SURVIVES the
+incident: a bounded in-memory ring (the ``timeseries.TimeSeries``
+bounded-ring idiom, applied to whole records) holds the tail of the
+event stream, and :func:`dump` writes it atomically as one JSON file
+the moment a trigger fires:
+
+- **watchdog alert transitions** (``watchdog.Watchdog.check`` dumps on
+  every rule that starts firing, and stamps the dump path into the
+  alert payload — so a ``DK_ALERT_CMD`` webhook line names the
+  artifact, not just the symptom);
+- **preemption** (the dispatch loop's boundary notice and the
+  ``preemption.on_request`` watcher both dump before the drain);
+- **unhandled crash** — :func:`attach` chains ``sys.excepthook`` and
+  ``threading.excepthook``, so an exception nobody caught (on ANY
+  thread) leaves a ``flightrec-*.json`` beside the event files
+  (``SystemExit``/``KeyboardInterrupt`` are deliberate exits, not
+  crashes — skipped);
+- **on demand** via the ``/tracez`` endpoint both HTTP servers serve
+  (:func:`tracez_doc`), or a direct :func:`dump` call.
+
+The ring is attached by ``events._resolve`` exactly when ``DK_OBS_DIR``
+selects an event log, so the zero-cost contract holds: recorder off =
+no ring, no hooks, no per-emit work.  Ring capacity is
+``DK_TRACE_RING`` records (default 2048); each record is the same dict
+the event writer serialized, trace ids included — which is what makes a
+set of dumps from different hosts stitchable by ``trace_id``
+(:func:`read_dumps` + ``trace_export.chrome_trace``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+from dist_keras_tpu.observability import events, metrics
+from dist_keras_tpu.utils import knobs
+
+_DUMP_PREFIX = "flightrec"
+
+
+class FlightRecorder:
+    """Bounded ring of event records + atomic dump writer."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            capacity = int(knobs.get("DK_TRACE_RING"))
+        self.capacity = max(16, int(capacity))
+        self._ring = collections.deque(maxlen=self.capacity)
+        # one lock for append AND copy: deque.append alone is
+        # thread-safe, but list(deque) raises "deque mutated during
+        # iteration" against a concurrent appender — and a dump that
+        # dies of that is lost exactly when the process is busiest.
+        # An uncontended acquire is ~100ns against the µs-scale json
+        # serialization each ringed record already paid.
+        self._lock = threading.Lock()
+        self._dump_seq = 0
+
+    def record(self, rec):
+        """Ring one record (the event writer's dict, post-serialize)."""
+        with self._lock:
+            self._ring.append(rec)
+
+    def records(self):
+        """Chronological copy of the retained records."""
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self):
+        return len(self._ring)
+
+    def stats(self):
+        return {"capacity": self.capacity, "n": len(self._ring),
+                "dumps": self._dump_seq}
+
+    def dump(self, reason, directory, rank, **fields):
+        """Write the ring to ``<directory>/flightrec-rank_{r}-p{pid}-
+        NNN-<reason>.json`` (tmp + rename, so a reader never sees a
+        torn dump); -> the path.  The pid in the name keeps a
+        supervised RELAUNCH into the same obs dir from overwriting the
+        previous incarnation's post-mortem (same rank, fresh seq
+        counter).  Raises on failure — :func:`dump` (module level) is
+        the never-throws wrapper."""
+        with self._lock:
+            seq = self._dump_seq
+            self._dump_seq += 1
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in str(reason)) or "dump"
+        path = os.path.join(
+            directory,
+            f"{_DUMP_PREFIX}-rank_{rank}-p{os.getpid()}-{seq:03d}-"
+            f"{safe}.json")
+        doc = {"reason": str(reason), "t": time.time(), "rank": rank,
+               "pid": os.getpid(), "fields": dict(fields),
+               "n": len(self._ring), "records": self.records()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+_lock = threading.Lock()
+_recorder = None
+_hooks_installed = False
+
+
+def recorder():
+    """The process-wide recorder (created on first use)."""
+    global _recorder
+    with _lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder
+
+
+def attach():
+    """Arm the recorder: register the events sink (every emitted record
+    is ringed) and chain the crash hooks.  Called by ``events._resolve``
+    when ``DK_OBS_DIR`` selects a writer; idempotent.  The sink is the
+    module-level :func:`record` — it resolves ``recorder()`` per call,
+    so a test's :func:`reset` swaps in a fresh ring without the sink
+    feeding a discarded one."""
+    events._sink = record
+    _install_crash_hooks()
+
+
+def record(rec):
+    recorder().record(rec)
+
+
+def dump(reason, **fields):
+    """Dump the ring to the active ``DK_OBS_DIR``; -> the dump path, or
+    None (log disabled, or the write failed — a recorder dump is a
+    best-effort artifact and must NEVER add a failure to the incident
+    it records).  Emits one ``flight_dump`` event naming the path and
+    counts ``flight.dumps``."""
+    d = events.obs_dir()
+    if d is None:
+        return None
+    try:
+        path = recorder().dump(reason, d, events.rank() or 0, **fields)
+    # dklint: ignore[broad-except] a failed dump must not add a failure to the incident it records
+    except Exception as e:
+        print(f"[dk.observability] WARNING: flight dump ({reason}) "
+              f"failed: {e!r}", file=sys.stderr, flush=True)
+        return None
+    metrics.counter("flight.dumps").inc()
+    events.emit("flight_dump", reason=str(reason), path=path,
+                n=len(recorder()), **fields)
+    return path
+
+
+def _install_crash_hooks():
+    """Chain ``sys.excepthook`` + ``threading.excepthook`` so an
+    UNHANDLED exception on any thread dumps the ring before the
+    process (or thread) dies.  The previous hooks always run after —
+    this is a recorder, not an error handler."""
+    global _hooks_installed
+    with _lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+    prev_sys = sys.excepthook
+    prev_threading = threading.excepthook
+
+    def _crash_dump(exc_type, exc, where):
+        if issubclass(exc_type, (SystemExit, KeyboardInterrupt)):
+            return  # deliberate exits (incl. Preempted) are not crashes
+        dump("crash", error=exc_type.__name__,
+             detail=str(exc)[:200], where=where)
+
+    def _sys_hook(exc_type, exc, tb):
+        _crash_dump(exc_type, exc, "main")
+        prev_sys(exc_type, exc, tb)
+
+    def _threading_hook(args):
+        _crash_dump(args.exc_type, args.exc_value,
+                    getattr(args.thread, "name", "?"))
+        prev_threading(args)
+
+    sys.excepthook = _sys_hook
+    threading.excepthook = _threading_hook
+
+
+def tracez_doc():
+    """The ``/tracez`` payload: recorder stats + the retained records
+    (JSON-ready — every record already round-tripped the writer's
+    serializer)."""
+    rec = recorder()
+    return {"rank": events.rank(), "enabled": events.enabled(),
+            **rec.stats(), "records": rec.records()}
+
+
+def load_dump(path):
+    """Read one dump file -> its document (the :func:`dump` schema)."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def dump_files(directory):
+    """-> sorted paths of every ``flightrec-*.json`` under
+    ``directory`` (including ``host_{i}/`` subdirs — the
+    ``Job.collect_obs`` layout, same convention as
+    ``report.event_files``)."""
+    directory = os.path.abspath(os.path.expanduser(str(directory)))
+    out = []
+    roots = [directory]
+    try:
+        for name in os.listdir(directory):
+            p = os.path.join(directory, name)
+            if name.startswith("host_") and os.path.isdir(p):
+                roots.append(p)
+    except OSError:
+        return []
+    for root in roots:
+        try:
+            names = os.listdir(root)
+        except OSError:
+            continue
+        out.extend(os.path.join(root, n) for n in names
+                   if n.startswith(_DUMP_PREFIX + "-")
+                   and n.endswith(".json"))
+    return sorted(out)
+
+
+def read_dumps(directory):
+    """Merge every host's recorder dumps into ONE deduplicated timeline
+    ordered by ``(t, rank, seq)`` — the stitching input for
+    ``trace_export``.  Two dumps from one process overlap (the ring
+    retains history across dumps); records are deduplicated by
+    ``(pid, rank, seq)`` — seq is unique per event writer, and the
+    dump's recorded pid distinguishes two INCARNATIONS of the same
+    rank (a supervised relaunch restarts seq at 0; without the pid its
+    records would vanish as false duplicates).  A torn/unreadable dump
+    is skipped, not fatal — the merger must work best exactly when the
+    run died worst."""
+    seen = set()
+    records = []
+    for path in dump_files(directory):
+        try:
+            doc = load_dump(path)
+        except (OSError, ValueError):
+            continue
+        pid = doc.get("pid")
+        for rec in doc.get("records", ()):
+            key = (pid, rec.get("rank", doc.get("rank", 0)),
+                   rec.get("seq"))
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(rec)
+    records.sort(key=lambda e: (e.get("t", 0.0), e.get("rank", 0),
+                                e.get("seq", 0)))
+    return records
+
+
+def reset():
+    """Drop the ring (tests).  The chained excepthooks stay installed
+    and the installed flag stays set — re-chaining on every reset would
+    stack hook frames; the hooks read the live recorder through
+    :func:`dump`, so a fresh ring is all a test needs."""
+    global _recorder
+    with _lock:
+        _recorder = None

@@ -201,6 +201,8 @@ KNOWN_METRICS = {
     # watchdog
     "watchdog.alerts": "counter",
     "watchdog.firing.*": "gauge",
+    # flight recorder (observability/flight.py)
+    "flight.dumps": "counter",
 }
 
 _lock = threading.Lock()

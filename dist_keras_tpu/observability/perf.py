@@ -127,6 +127,7 @@ def phase(name, **fields):
     through ``spans.span`` (-> ``TraceAnnotation`` + span events), so
     XProf and the histograms share a vocabulary without per-chunk JSON
     emission on production runs."""
+    # dklint: spans=perf.*
     cm = (spans.span(f"perf.{name}", **fields)
           if spans.device_trace_active() else contextlib.nullcontext())
     with cm:

@@ -139,6 +139,23 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"status": "ok"}).encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
+        elif path == "/statusz":
+            # the SAME build/config/open-span document the serving
+            # front end serves — one shared renderer, one shape
+            from dist_keras_tpu.observability import statusz
+
+            body = statusz.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/tracez":
+            # flight-recorder ring on demand (default=str: records
+            # hold pre-serialization field values)
+            from dist_keras_tpu.observability import flight
+
+            body = json.dumps(flight.tracez_doc(),
+                              default=str).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         else:
             body = json.dumps({"error": "not_found",
                                "path": self.path}).encode("utf-8")
@@ -152,7 +169,10 @@ class _Handler(BaseHTTPRequestHandler):
 class Exporter(ThreadingHTTPServer):
     """Standalone per-host scrape endpoint: ``GET /metrics`` (alias
     ``/metricsz``) serves the live registry exposition; ``/healthz``
-    answers 200.  ``port=0`` binds an ephemeral port (tests)."""
+    answers 200; ``/statusz`` serves the shared build/config/open-span
+    snapshot and ``/tracez`` the flight-recorder ring (same documents
+    as the serving front end).  ``port=0`` binds an ephemeral port
+    (tests)."""
 
     daemon_threads = True
 

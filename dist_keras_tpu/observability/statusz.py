@@ -1,0 +1,70 @@
+"""``/statusz`` — one build/config/liveness snapshot for every server.
+
+The serving front end (``serving/server.py``) and the standalone
+Prometheus exporter (``observability/prometheus.py``) both answer
+``GET /statusz`` with exactly this document, so an operator (or the
+future router tier) reads ONE shape regardless of which port answered:
+
+- ``build`` — python/platform/pid, plus the jax version when the
+  process has loaded it (checked via ``sys.modules`` — this module must
+  stay importable from the report CLI without dragging jax in);
+- ``knobs`` — the effective value of every registered ``DK_*`` knob
+  (parsed, defaults applied) plus whether the env actually set it: the
+  "what configuration is this process REALLY running" answer that env
+  dumps and launch scripts only approximate;
+- ``spans`` — the open-span path per live thread
+  (``spans.open_spans()``): a wedged process shows WHERE it is wedged;
+- ``flight`` — recorder ring stats (capacity / retained / dumps);
+- ``uptime_s`` since this module first rendered (process-start proxy).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from dist_keras_tpu.observability import events, flight, spans
+from dist_keras_tpu.utils import knobs
+
+_t0 = time.time()
+
+
+def status_doc(extra=None):
+    """-> the JSON-ready status document (``extra`` merges in a
+    server-specific section, e.g. the serving engine's stats)."""
+    import platform
+
+    knob_rows = {}
+    for name, knob in knobs.KNOBS.items():
+        try:
+            value = knobs.get(name)
+        except ValueError:  # on_error="raise" knobs with malformed env
+            value = "<malformed>"
+        knob_rows[name] = {"value": value,
+                           "set": knobs.raw(name) is not None}
+    doc = {
+        "build": {
+            "python": sys.version.split()[0],
+            "platform": platform.platform(),
+            "pid": os.getpid(),
+            "jax": getattr(sys.modules.get("jax"), "__version__", None),
+        },
+        "rank": events.rank(),
+        "obs_dir": events.obs_dir(),
+        "uptime_s": round(time.time() - _t0, 1),
+        "knobs": knob_rows,
+        "spans": spans.open_spans(),
+        "flight": flight.recorder().stats(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def render(extra=None):
+    """The shared ``/statusz`` body — both HTTP servers serve these
+    exact bytes (plus their own ``extra`` section)."""
+    return json.dumps(status_doc(extra=extra), indent=1, default=str,
+                      sort_keys=False)

@@ -402,6 +402,21 @@ class Watchdog:
                                 f"watchdog.firing.{rule.name}").set(0)
             if transition:
                 alert = {"rule": rule.name, "t": now, **fields}
+                # dump the flight recorder FIRST and stamp the path
+                # into the alert payload: the DK_ALERT_CMD webhook line
+                # then names the artifact to open, not just the
+                # symptom — an alert is actionable without shell
+                # archaeology.  Transition-only cadence bounds the I/O.
+                try:
+                    from dist_keras_tpu.observability import flight
+
+                    dump_path = flight.dump("watchdog_alert",
+                                            rule=rule.name)
+                # dklint: ignore[broad-except] a failed dump must not block the alert delivery
+                except Exception:  # pragma: no cover - dump optional
+                    dump_path = None
+                if dump_path is not None:
+                    alert["dump_path"] = dump_path
                 self.alerts.append(alert)
                 fired.append(alert)
                 events.emit("watchdog_alert", **alert)

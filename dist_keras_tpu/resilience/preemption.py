@@ -200,6 +200,18 @@ def on_request(callback, poll_s=0.05):
             sig = _requested
             if sig is not None:
                 try:
+                    # flight-record the tail BEFORE the reaction: the
+                    # callback (a serving drain) may outlive the grace
+                    # window — the post-mortem must already be on disk.
+                    # Ordinary thread context here, so dumping is safe
+                    # (the signal handler itself stays emit-free).
+                    from dist_keras_tpu.observability import flight
+
+                    flight.dump("preempt", signum=int(sig))
+                # dklint: ignore[broad-except] the dump is best-effort; the drain callback must still run
+                except Exception:  # pragma: no cover - dump optional
+                    pass
+                try:
                     callback(sig)
                 finally:
                     stop.set()

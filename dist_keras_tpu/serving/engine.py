@@ -49,8 +49,12 @@ Design points:
 Observability: every seam emits — ``serve_enqueue``,
 ``serve_batch_flush`` (with fill ratio), ``serve_predict`` (with
 duration), ``serve_reload``, ``serve_drain`` — and the
-``serve.*`` metrics ride the registry snapshots.  All of it is the
-usual zero-cost no-op when ``DK_OBS_DIR`` is unset.
+``serve.*`` metrics ride the registry snapshots.  With tracing on,
+``submit`` captures the caller's span context into the request, and the
+batcher/replica threads stamp ``serve.queue_wait`` / ``serve.batch`` /
+``serve.exec`` spans into that request's trace — one request is one
+connected trace across the thread handoff.  All of it is the usual
+zero-cost no-op when ``DK_OBS_DIR`` is unset.
 """
 
 from __future__ import annotations
@@ -67,7 +71,7 @@ import jax
 import jax.numpy as jnp
 
 from dist_keras_tpu.data.streaming import pack_rows
-from dist_keras_tpu.observability import events, metrics, perf
+from dist_keras_tpu.observability import events, metrics, perf, spans
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.utils.serialization import (
     deserialize_model,
@@ -94,7 +98,12 @@ class Overloaded(RuntimeError):
                else "") + ")")
 
 
-_Request = collections.namedtuple("_Request", ("x", "future", "t"))
+# t: monotonic admission instant (queue-wait math); tw: wall-clock twin
+# (retro span timestamps); ctx: the submitter's captured trace context —
+# the batcher/replica threads stamp their stages into THAT request's
+# trace, so one request stays one connected trace across the handoff
+_Request = collections.namedtuple("_Request",
+                                  ("x", "future", "t", "tw", "ctx"))
 
 
 class _Replica:
@@ -252,7 +261,8 @@ class ServingEngine:
                     f"row shape {x.shape} does not match this engine's "
                     f"feature shape {self.feature_shape} (locked at "
                     "construction or by the first admitted row)")
-            self._pending.append(_Request(x, fut, time.monotonic()))
+            self._pending.append(_Request(x, fut, time.monotonic(),
+                                          time.time(), spans.capture()))
             self._outstanding += 1
             self._n_enqueued += 1
             pending = len(self._pending)
@@ -363,10 +373,22 @@ class ServingEngine:
                 self._shapes.add((rung,) + x.shape[1:])
             for r in take:
                 self._m_wait.observe(now - r.t)
+            if events.enabled():
+                # retro-stamp each request's queue wait into ITS OWN
+                # trace (submit wall clock -> this pop) — the first
+                # half of the handler->batcher handoff
+                noww = time.time()
+                for r in take:
+                    spans.span_at("serve.queue_wait", r.ctx, r.tw,
+                                  noww)
             self._m_fill.observe(n / rung)
-            events.emit("serve_batch_flush", rung=rung, n=n,
-                        fill_ratio=n / rung)
-            self._pick_replica().inbox.put((x, take))
+            # the batch itself is one span, parented to the first
+            # request's trace (its flush event auto-stamps the same ids)
+            with spans.resume(take[0].ctx):
+                with spans.span("serve.batch", rung=rung, n=n):
+                    events.emit("serve_batch_flush", rung=rung, n=n,
+                                fill_ratio=n / rung)
+                    self._pick_replica().inbox.put((x, take))
 
     # -- replicas -------------------------------------------------------
     def _replica_loop(self, rep):
@@ -381,6 +403,7 @@ class ServingEngine:
                 break
             x, reqs = item
             t0 = time.perf_counter()
+            tw0 = time.time()
             try:
                 fault_point("serve.predict")
                 perf.count_dispatch()  # one compiled launch per batch
@@ -414,6 +437,14 @@ class ServingEngine:
                 self._reg_predict.observe(dt)
                 events.emit("serve_predict", replica=rep.index,
                             n=len(reqs), rung=len(x), duration_s=dt)
+                if events.enabled():
+                    # the in-flight window, stamped into every
+                    # request's trace from the REPLICA thread — the
+                    # second half of the cross-thread handoff
+                    tw1 = time.time()
+                    for r in reqs:
+                        spans.span_at("serve.exec", r.ctx, tw0, tw1,
+                                      replica=rep.index, rung=len(x))
                 for r, p in zip(reqs, preds[:len(reqs)]):
                     r.future.set_result(p)
             finally:

@@ -20,6 +20,16 @@ Endpoints:
   balancer stops routing here during the grace window.
 - ``GET /metricsz`` — engine stats + the process metrics registry
   snapshot, JSON.
+- ``GET /statusz`` — build/config/knob snapshot + open-span summary
+  (the shared ``observability.statusz`` renderer, plus an ``engine``
+  section) — the same document the standalone metrics exporter serves.
+- ``GET /tracez`` — the flight recorder's retained span/event records.
+
+Tracing: ``POST /predict`` honors an incoming ``traceparent`` header
+(W3C ``00-<trace>-<span>-01``) — the whole request lifecycle runs under
+one ``serve.request`` span continuing the caller's trace, the
+batcher/replica threads stamp their stages into it, and the response
+echoes a ``traceparent`` naming that span for client-side correlation.
 
 Graceful drain rides the EXISTING preemption path
 (``resilience.preemption``): :meth:`ServingServer.install_signal_drain`
@@ -41,7 +51,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from dist_keras_tpu.observability import events
+from dist_keras_tpu.observability import events, spans
 from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.resilience import preemption
 from dist_keras_tpu.serving.engine import Overloaded
@@ -61,6 +71,7 @@ def default_port(fallback=8000):
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dk-serve/0.1"
     protocol_version = "HTTP/1.1"
+    _trace_header = None  # per-request traceparent echo (do_POST sets it)
 
     def log_message(self, fmt, *args):  # quiet: the event log is the log
         pass
@@ -76,11 +87,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
+        if self._trace_header is not None:
+            # the round-trip half of trace propagation: the response
+            # names the serve.request span the caller's trace continued
+            # into, so a client log line and a server trace correlate
+            self.send_header("traceparent", self._trace_header)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         srv = self.server
+        self._trace_header = None  # keep-alive: no stale POST echo
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             if srv.engine.draining or not srv.engine.running:
@@ -110,11 +127,31 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, {"engine": srv.engine.stats(),
                                   "registry": _metrics.snapshot()})
+        elif path == "/statusz":
+            # build/config/open-span snapshot — the SHARED renderer
+            # (observability.statusz) both this server and the
+            # standalone exporter serve, plus the engine section
+            from dist_keras_tpu.observability import statusz
+
+            self._reply_text(
+                200, statusz.render(extra={"engine": srv.engine.stats()}),
+                "application/json")
+        elif path == "/tracez":
+            # the flight recorder's retained span/event records, on
+            # demand — the live half of the dump-on-incident story.
+            # default=str: ring records hold the PRE-serialization
+            # field values (numpy scalars and friends included)
+            from dist_keras_tpu.observability import flight
+
+            self._reply_text(200, json.dumps(flight.tracez_doc(),
+                                             default=str),
+                             "application/json")
         else:
             self._reply(404, {"error": "not_found", "path": self.path})
 
     def do_POST(self):
         srv = self.server
+        self._trace_header = None
         if self.path.split("?")[0] != "/predict":
             self._reply(404, {"error": "not_found", "path": self.path})
             return
@@ -129,25 +166,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "bad_request",
                               "detail": str(e)[:200]})
             return
+        # the whole lifecycle — admission, queue wait, batching,
+        # in-flight, reply assembly — runs under ONE serve.request span
+        # continuing the caller's trace when a traceparent header came
+        # in (a malformed header degrades to a fresh root, never a 4xx)
+        ctx = spans.parse_traceparent(self.headers.get("traceparent"))
+        with spans.resume(ctx):
+            with spans.span("serve.request", n=len(rows)):
+                self._trace_header = spans.traceparent()
+                code, payload, retry_after = self._predict(srv, rows)
+                self._reply(code, payload, retry_after=retry_after)
+
+    def _predict(self, srv, rows):
+        """Admission + result gathering -> (status, payload,
+        retry_after) with the engine's typed failure mapping."""
         try:
             futs = [srv.engine.submit(r) for r in rows]
         except Overloaded as e:
             # the engine's typed backpressure -> LB-visible 503; rows
             # admitted before the rejection still complete inside the
             # engine (rejected-not-lost), the caller just retries whole
-            self._reply(503, {"error": "overloaded", "reason": e.reason,
-                              "pending": e.pending,
-                              "capacity": e.capacity}, retry_after=1)
-            return
+            return 503, {"error": "overloaded", "reason": e.reason,
+                         "pending": e.pending,
+                         "capacity": e.capacity}, 1
         except ValueError as e:  # row shape mismatch: the CALLER's bug
-            self._reply(400, {"error": "bad_request",
-                              "detail": str(e)[:200]})
-            return
+            return 400, {"error": "bad_request",
+                         "detail": str(e)[:200]}, None
         # dklint: ignore[broad-except] admission error maps to a typed HTTP status, never a dead handler
         except Exception as e:  # typed admission error (enqueue fault)
-            self._reply(500, {"error": type(e).__name__,
-                              "detail": str(e)[:200]})
-            return
+            return 500, {"error": type(e).__name__,
+                         "detail": str(e)[:200]}, None
         try:
             deadline = time.monotonic() + srv.request_timeout_s
             preds = [f.result(timeout=max(0.0,
@@ -155,17 +203,15 @@ class _Handler(BaseHTTPRequestHandler):
                      for f in futs]
         except (TimeoutError, concurrent.futures.TimeoutError):
             # (distinct classes before py3.11, one alias after)
-            self._reply(504, {"error": "timeout",
-                              "timeout_s": srv.request_timeout_s})
-            return
+            return 504, {"error": "timeout",
+                         "timeout_s": srv.request_timeout_s}, None
         # dklint: ignore[broad-except] predict error maps to a typed HTTP 500 naming the type
         except Exception as e:  # typed predict error (fault, OOM, ...)
-            self._reply(500, {"error": type(e).__name__,
-                              "detail": str(e)[:200]})
-            return
-        self._reply(200, {
+            return 500, {"error": type(e).__name__,
+                         "detail": str(e)[:200]}, None
+        return 200, {
             "predictions": [np.asarray(p).tolist() for p in preds],
-            "n": len(preds)})
+            "n": len(preds)}, None
 
 
 class ServingServer(ThreadingHTTPServer):

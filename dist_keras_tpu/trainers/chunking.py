@@ -28,6 +28,7 @@ import numpy as np
 
 from dist_keras_tpu.observability import events as obs_events
 from dist_keras_tpu.observability import perf
+from dist_keras_tpu.observability import spans as obs_spans
 from dist_keras_tpu.resilience import coordination, preemption
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.guards import check_losses
@@ -391,6 +392,15 @@ class ChunkRunner:
         perf.install()
         tr.record_training_start()
         t_mark = time.time()
+        # the run's ROOT span: every per-chunk breadcrumb, coordination
+        # vote and checkpoint event below auto-stamps its trace identity
+        # (and the async writer's ckpt.save span resumes it), so a whole
+        # training run stitches into one trace — on a launched pod,
+        # DK_TRACE_ID makes that trace span every host.  Entered/exited
+        # manually: the existing try/except/finally unwind structure
+        # must stay byte-identical.
+        _run_span = obs_spans.span("train.run", start=self.start)
+        _run_span.__enter__()
         try:
             for i, K in enumerate(self.plan):
                 sig = (preemption.requested()
@@ -437,6 +447,14 @@ class ChunkRunner:
                     obs_events.emit("preempt", signum=int(sig),
                                     units_done=units_done,
                                     adopted=not signalled)
+                    # crash-safe tail: the grace window may not survive
+                    # the drain+save below, so the recorder dumps NOW —
+                    # the post-mortem exists even if the scheduler's
+                    # second SIGTERM lands mid-checkpoint
+                    if obs_events.enabled():
+                        from dist_keras_tpu.observability import flight
+                        flight.dump("preempt", signum=int(sig),
+                                    units_done=units_done)
                     while pending:
                         _retire_one()
                     if coord.world > 1:
@@ -564,6 +582,7 @@ class ChunkRunner:
                 timeout_s=0 if isinstance(e, TimeoutError) else None)
             raise
         finally:
+            _run_span.__exit__(None, None, None)
             # exception-safe (a raising user callback must not leave
             # the feed pinning the host epoch tensors)
             if self.feed is not None:

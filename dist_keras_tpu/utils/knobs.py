@@ -151,6 +151,19 @@ _register("DK_OBS_ROTATE_MB", 0.0, float, kind="MB",
 _register("DK_OBS_ROTATE_KEEP", 3, int,
           "rotated event segments retained per host")
 
+# observability: tracing + flight recorder
+_register("DK_TRACE_ID", None, str,
+          "job-wide trace id (32 hex chars) adopted by every root span "
+          "— exported per host by `launch.Job(obs_dir=...)` so a whole "
+          "pod stitches into one trace")
+_register("DK_TRACE_SEED", None, int,
+          "seed for trace/span id minting: set = ids are a pure "
+          "function of the seed (gate/test replay); unset = OS entropy")
+_register("DK_TRACE_RING", 2048, int,
+          "flight-recorder ring capacity (recent span/event records "
+          "retained in memory per process, dumped on watchdog alerts, "
+          "preemption, crash, or `/tracez`)")
+
 # observability: telemetry plane
 _register("DK_OBS_SAMPLE_S", None, float, kind="seconds",
           doc="metrics-sampler cadence; unset = no sampler thread, no "

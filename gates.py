@@ -36,7 +36,16 @@ epoch/checkpoint/barrier events, names the signalled rank and the
 agreed save step, and carries per-phase span durations; and (b) event
 emission costs <5% wall-clock versus the ``DK_OBS_DIR``-unset run
 (min-of-3 train timings inside each worker, so process start/compile
-noise stays out of the comparison).
+noise stays out of the comparison).  The same gate then runs the
+TRACING phases (round 16): (c) span emission on the serving hot path
+must cost <5% of the mean request latency (median-per-emit x count)
+and the DISABLED path must hand out one shared no-op span that
+allocates nothing across 10k calls; and (d) an end-to-end client +
+server pair — a traced training step, an async save, three traceparent
+HTTP requests, one injected thread crash and one preemption — whose
+flight-recorder DUMPS alone must stitch (by trace_id) into one
+connected trace per request, spanning a thread handoff and the
+process boundary, with a Perfetto-loadable export.
 
 The SERVING gate (``--serving-only``) runs two CPU subprocess
 scenarios: a load worker (the engine must sustain a fixed offered QPS
@@ -290,6 +299,232 @@ for i in range(6):
     units += 1
 print("NOT_PREEMPTED", rank, flush=True)
 sys.exit(1)
+"""
+
+
+# The tracing worker (three modes, one subprocess each), run by the
+# SAME --obs-only gate:
+#
+# "overhead" — the tracing-overhead bound on the serving hot path,
+#           measured the round-15 way (median-per-emit x count — a
+#           scheduler preemption inflates one sample, the median
+#           discards it): per-request span-emission cost must stay
+#           under 5% of the mean request latency at a paced offered
+#           load; then the DISABLED path: span() must hand out one
+#           shared no-op object and allocate nothing across 10k calls
+#           (sys.getallocatedblocks delta), and capture() must
+#           short-circuit to None.
+# "server"  — rank 1: a real ServingServer under DK_OBS_DIR; serves the
+#           client's traced requests, then crashes a worker thread via
+#           an armed fault point -> the chained threading.excepthook
+#           dumps the flight recorder (reason "crash").
+# "client"  — rank 0: a real tiny training run (train.run root span +
+#           chunk breadcrumbs), an async checkpoint save under an open
+#           span (the ckpt.save span lands on the WRITER thread resumed
+#           into the caller's trace — the snapshot->write handoff),
+#           three traced HTTP requests ACROSS the process boundary
+#           (traceparent header out, echo asserted back), /tracez +
+#           /statusz probes, then a preemption request -> the
+#           on_request watcher dumps the recorder (reason "preempt").
+#           The gate stitches BOTH ranks' dumps by trace_id and asserts
+#           every request is ONE connected trace: a single root, zero
+#           orphans, >= 1 thread handoff and >= 1 process handoff.
+_TRACE_WORKER = r"""
+import gc, json, os, signal, statistics, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %REPO%)
+import numpy as np
+
+mode = sys.argv[1]
+
+if mode == "overhead":
+    obs_dir = sys.argv[2]
+    os.environ["DK_OBS_DIR"] = obs_dir
+    os.environ["DK_TRACE_SEED"] = "5"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.observability import events as obs_events
+    from dist_keras_tpu.observability import spans
+    from dist_keras_tpu.serving import ServingEngine
+
+    samples = []            # per-emit walls, every thread
+    n_span = [0]            # span_begin/span_end emissions only
+    tls = threading.local()
+    real_emit = obs_events.emit
+
+    def timed(kind, **fields):
+        if getattr(tls, "in_emit", False):
+            return real_emit(kind, **fields)
+        tls.in_emit = True
+        t0 = time.perf_counter()
+        try:
+            return real_emit(kind, **fields)
+        finally:
+            samples.append(time.perf_counter() - t0)
+            if kind in ("span_begin", "span_end"):
+                n_span[0] += 1
+            tls.in_emit = False
+
+    obs_events.emit = timed
+    eng = ServingEngine(
+        mnist_mlp(hidden=(16,), input_dim=8, num_classes=3),
+        replicas=1, batch_ladder=(1, 8, 32), max_latency_s=0.01,
+        max_queue=4096)
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(64, 8)).astype(np.float32)
+    for r in (1, 8, 32):
+        eng.predict(rows[:r], timeout_s=120)   # warm every rung
+    del samples[:]
+    n_span[0] = 0
+    lat = []
+    futs = []
+    N = 400
+    for i in range(N):   # paced: rungs rarely fill -> flush-bound latency
+        t0 = time.perf_counter()
+        f = eng.submit(rows[i % len(rows)])
+        f.add_done_callback(
+            lambda _f, t0=t0: lat.append(time.perf_counter() - t0))
+        futs.append(f)
+        time.sleep(0.002)
+    for f in futs:
+        f.result(timeout=60)
+    med = statistics.median(samples) if samples else 0.0
+    mean_lat = sum(lat) / len(lat) if lat else 0.0
+    per_req = med * n_span[0] / N
+    print("SPAN_EMITS", n_span[0], flush=True)
+    print("TRACE_FRAC", (per_req / mean_lat) if mean_lat > 0 else 0.0,
+          flush=True)
+    eng.close()
+    # the disabled path: shared no-op, zero net allocation, None capture
+    obs_events.emit = real_emit
+    del os.environ["DK_OBS_DIR"]
+    obs_events.reset()
+    spans.reset()
+    assert spans.span("x") is spans.span("y"), "no-op span not shared"
+    for _ in range(100):   # warm interned state before measuring
+        with spans.span("x"):
+            pass
+    gc.collect()
+    b0 = sys.getallocatedblocks()
+    for _ in range(10000):
+        with spans.span("x"):
+            pass
+    print("NOOP_ALLOC", sys.getallocatedblocks() - b0, flush=True)
+    print("NOOP_CAPTURE", spans.capture() is None, flush=True)
+    sys.exit(0)
+
+if mode == "server":
+    port_file, stop_file, obs_dir = sys.argv[2], sys.argv[3], sys.argv[4]
+    os.environ["DK_OBS_DIR"] = obs_dir
+    os.environ["DK_COORD_RANK"] = "1"
+    os.environ["DK_TRACE_SEED"] = "11"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.observability import flight
+    from dist_keras_tpu.resilience import faults
+    from dist_keras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(
+        mnist_mlp(hidden=(16,), input_dim=8, num_classes=3),
+        replicas=1, batch_ladder=(1, 8), max_latency_s=0.002)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, port_file)
+    t_end = time.monotonic() + 90
+    while not os.path.exists(stop_file) and time.monotonic() < t_end:
+        time.sleep(0.05)
+
+    # injected crash on a worker thread: the armed fault raises
+    # UNCAUGHT -> the chained threading.excepthook dumps the recorder
+    def boom():
+        with faults.armed("step.loss"):
+            faults.fault_point("step.loss")
+
+    t = threading.Thread(target=boom, name="crash-me")
+    t.start()
+    t.join()
+    print("SERVER_DUMPS",
+          len([p for p in flight.dump_files(obs_dir) if "rank_1" in p]),
+          flush=True)
+    srv.close()
+    sys.exit(0)
+
+if mode == "client":
+    port, obs_dir, ck_dir = int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    os.environ["DK_OBS_DIR"] = obs_dir
+    os.environ["DK_COORD_RANK"] = "0"
+    os.environ["DK_TRACE_SEED"] = "7"
+    from urllib import request as _rq
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dist_keras_tpu.checkpoint import Checkpointer
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.observability import flight, spans
+    from dist_keras_tpu.resilience import preemption
+    from dist_keras_tpu.trainers import SingleTrainer
+    from dist_keras_tpu.utils.misc import one_hot
+
+    # (1) a real training step: train.run root span + chunk breadcrumbs
+    rng = np.random.default_rng(0)
+    n = 256
+    y = rng.integers(0, 2, n)
+    ds = Dataset({"features": rng.normal(size=(n, 16)).astype(np.float32),
+                  "label": y, "label_encoded": one_hot(y, 2)})
+    SingleTrainer(mnist_mlp(hidden=(32,), input_dim=16, num_classes=2),
+                  batch_size=128, num_epoch=1,
+                  label_col="label_encoded").train(ds)
+    # (2) an async save under an open span: the ckpt.save span lands on
+    # the writer thread, resumed into this trace (thread handoff #1)
+    ck = Checkpointer(ck_dir)
+    with spans.span("train.run", start=0):
+        ck.save(1, {"w": np.zeros((64, 64), np.float32)}).wait(
+            timeout_s=30)
+        ckpt_trace = spans.current().trace_id
+    print("CKPT_TRACE", ckpt_trace, flush=True)
+    # (3) traced requests ACROSS the process boundary
+    for i in range(3):
+        with spans.span("serve.client", i=i):
+            tp = spans.traceparent()
+            req = _rq.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"rows": [[0.1] * 8]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": tp})
+            with _rq.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200, resp.status
+                echo = resp.headers.get("traceparent")
+            # round trip: the response names a span of OUR trace
+            assert echo and echo.split("-")[1] == tp.split("-")[1], \
+                (echo, tp)
+            print("TRACE", tp.split("-")[1], flush=True)
+    with _rq.urlopen(f"http://127.0.0.1:{port}/tracez", timeout=10) as r:
+        tz = json.loads(r.read().decode())
+    assert tz["n"] > 0 and any(
+        rec.get("kind") == "span_end" for rec in tz["records"]), \
+        "tracez held no spans"
+    with _rq.urlopen(f"http://127.0.0.1:{port}/statusz", timeout=10) as r:
+        stz = json.loads(r.read().decode())
+    assert "DK_TRACE_RING" in stz.get("knobs", {}) and "engine" in stz, \
+        "statusz incomplete"
+    print("ENDPOINTS_OK", flush=True)
+    # (4) preemption -> the on_request watcher dumps the recorder
+    done = threading.Event()
+    preemption.on_request(lambda s: done.set(), poll_s=0.01)
+    preemption.request(signal.SIGTERM)
+    assert done.wait(10), "preemption watcher never fired"
+    print("CLIENT_DUMPS",
+          len([p for p in flight.dump_files(obs_dir) if "rank_0" in p]),
+          flush=True)
+    sys.exit(0)
+
+sys.exit(2)
 """
 
 
@@ -1539,15 +1774,19 @@ def run_obs_gate(timeout=300):
     script = os.path.join(work, "worker.py")
     with open(script, "w") as f:
         f.write(_OBS_WORKER.replace("%REPO%", repr(REPO)))
+    trace_script = os.path.join(work, "trace_worker.py")
+    with open(trace_script, "w") as f:
+        f.write(_TRACE_WORKER.replace("%REPO%", repr(REPO)))
     base_env = {k: v for k, v in os.environ.items()
                 if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
-                                     "DK_ALERT"))
+                                     "DK_ALERT", "DK_TRACE"))
                 and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
         "PYTHONPATH", "")
     failures = []
     overhead = None
     wall_delta = None
+    trace_frac = None
     t0 = time.time()
     try:
         obs_dir = os.path.join(work, "obs")
@@ -1620,6 +1859,137 @@ def run_obs_gate(timeout=300):
         if st_obs.get("TRAIN_S") and st_base.get("TRAIN_S"):
             wall_delta = (st_obs["TRAIN_S"] - st_base["TRAIN_S"]) \
                 / st_base["TRAIN_S"]
+
+        # (c) tracing overhead on the serving hot path + the disabled
+        # path's zero-allocation/no-op contract
+        oh = subprocess.run(
+            [sys.executable, trace_script, "overhead",
+             os.path.join(work, "trace_obs")],
+            capture_output=True, text=True, env=dict(base_env),
+            timeout=timeout)
+        st = {}
+        for key in ("TRACE_FRAC", "NOOP_ALLOC"):
+            m = re.search(rf"^{key} ([0-9.eE+-]+)$", oh.stdout, re.M)
+            if m:
+                st[key] = float(m.group(1))
+        if oh.returncode != 0:
+            failures.append(f"trace overhead worker rc={oh.returncode}:"
+                            f" {oh.stdout[-300:]} {oh.stderr[-300:]}")
+        trace_frac = st.get("TRACE_FRAC")
+        if trace_frac is None:
+            failures.append(f"missing TRACE_FRAC: {oh.stdout[-200:]}")
+        elif trace_frac >= 0.05:
+            failures.append(
+                f"span emission adds {trace_frac:.1%} of the mean "
+                "request latency on the serving hot path (bound 5%)")
+        noop_alloc = st.get("NOOP_ALLOC")
+        if noop_alloc is None or noop_alloc >= 8:
+            # net allocated blocks across 10k disabled span() calls:
+            # the shared no-op must retain NOTHING (a tiny slack
+            # absorbs interpreter-internal caches)
+            failures.append(f"disabled span path allocated "
+                            f"{noop_alloc} blocks over 10k calls")
+        if "NOOP_CAPTURE True" not in oh.stdout:
+            failures.append("capture() not None with tracing off")
+
+        # (d) end-to-end stitched trace: client + server processes, one
+        # injected crash + one preemption dump, every request ONE
+        # connected trace across a thread handoff and the process
+        # boundary — assembled from the flight-recorder DUMPS alone
+        obs2 = os.path.join(work, "trace_e2e", "obs")
+        os.makedirs(obs2, exist_ok=True)
+        port_file = os.path.join(work, "trace_e2e", "port")
+        stop_file = os.path.join(work, "trace_e2e", "stop")
+        server = subprocess.Popen(
+            [sys.executable, trace_script, "server", port_file,
+             stop_file, obs2],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=dict(base_env), text=True)
+        port = None
+        t_wait = time.monotonic() + 60
+        while time.monotonic() < t_wait:
+            if os.path.exists(port_file):
+                with open(port_file) as f:
+                    port = int(f.read().strip())
+                break
+            if server.poll() is not None:
+                break
+            time.sleep(0.05)
+        client_out = ""
+        if port is None:
+            failures.append("trace server never published its port: "
+                            + server.communicate()[0][-300:])
+        else:
+            client = subprocess.run(
+                [sys.executable, trace_script, "client", str(port),
+                 obs2, os.path.join(work, "trace_e2e", "ck")],
+                capture_output=True, text=True, env=dict(base_env),
+                timeout=timeout)
+            client_out = client.stdout
+            if client.returncode != 0:
+                failures.append(
+                    f"trace client rc={client.returncode}: "
+                    f"{client.stdout[-300:]} {client.stderr[-300:]}")
+        with open(stop_file, "w") as f:
+            f.write("stop")
+        try:
+            server_out = server.communicate(timeout=60)[0]
+        except subprocess.TimeoutExpired:
+            server.kill()
+            server_out = server.communicate()[0]
+            failures.append("trace server hung after stop")
+        m = re.search(r"^SERVER_DUMPS (\d+)$", server_out, re.M)
+        if not m or int(m.group(1)) < 1:
+            failures.append(f"no crash dump from the server worker: "
+                            f"{server_out[-300:]}")
+        m = re.search(r"^CLIENT_DUMPS (\d+)$", client_out, re.M)
+        if not m or int(m.group(1)) < 1:
+            failures.append("no preempt dump from the client worker")
+        if "ENDPOINTS_OK" not in client_out:
+            failures.append("client /tracez+/statusz probes failed")
+        request_traces = re.findall(r"^TRACE ([0-9a-f]{32})$",
+                                    client_out, re.M)
+        ckpt_trace = re.search(r"^CKPT_TRACE ([0-9a-f]{32})$",
+                               client_out, re.M)
+        from dist_keras_tpu.observability import flight, trace_export
+
+        stitched = flight.read_dumps(obs2)
+        ct = trace_export.connected_traces(stitched)
+        if len(request_traces) != 3:
+            failures.append(f"expected 3 request traces, saw "
+                            f"{request_traces}")
+        for tid in request_traces:
+            row = ct.get(tid)
+            if row is None:
+                failures.append(f"request trace {tid} absent from the "
+                                "stitched dumps")
+                continue
+            if not row["connected"]:
+                failures.append(f"request trace {tid} not connected: "
+                                f"{row}")
+            if row["ranks"] != [0, 1]:
+                failures.append(f"request trace {tid} did not span "
+                                f"both processes: {row}")
+            if row["cross_rank"] < 1 or row["cross_thread"] < 1:
+                failures.append(f"request trace {tid} missing a "
+                                f"handoff edge: {row}")
+            if "serve.client" not in row["roots"]:
+                failures.append(f"request trace {tid} root is not the "
+                                f"client span: {row}")
+        if ckpt_trace is None:
+            failures.append("client printed no CKPT_TRACE")
+        else:
+            row = ct.get(ckpt_trace.group(1))
+            if row is None or not row["connected"] \
+                    or row["cross_thread"] < 1:
+                failures.append(
+                    "async ckpt save did not stitch into the caller's "
+                    f"trace across the writer-thread handoff: {row}")
+        doc = trace_export.chrome_trace(stitched)
+        phs = {e.get("ph") for e in doc["traceEvents"]}
+        if not {"X", "s", "f"} <= phs:
+            failures.append(f"Perfetto export missing slice/flow "
+                            f"events: phases {sorted(phs)}")
     finally:
         shutil.rmtree(work, ignore_errors=True)
     return {
@@ -1632,6 +2002,8 @@ def run_obs_gate(timeout=300):
         "seconds": round(time.time() - t0, 1),
         "overhead_frac": (round(overhead, 4) if overhead is not None
                           else None),
+        "trace_frac": (round(trace_frac, 4) if trace_frac is not None
+                       else None),
         "wall_delta_frac_informational": (
             round(wall_delta, 4) if wall_delta is not None else None),
         "failures": failures,

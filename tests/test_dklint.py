@@ -363,6 +363,54 @@ def test_knob_table_reconstruction_matches_doc_table():
     assert rows == knobs.doc_table().splitlines()[2:]
 
 
+# -- registry rules: spans (round 16) ----------------------------------
+
+SPANS_FIXTURE = """
+    KNOWN_SPANS = ("train.run", "perf.*")
+
+
+    def span(name, **fields):
+        pass
+
+
+    def span_at(name, ctx, t0, t1, **fields):
+        pass
+"""
+
+
+def test_span_unregistered_and_dynamic(tmp_path):
+    fs = lint(tmp_path, {
+        "spans.py": SPANS_FIXTURE,
+        "x.py": """
+            from spans import span
+
+            span("train.run")
+            span("perf.step")
+            span("mystery.phase")
+            span(name)
+        """}, rules=["span-unregistered", "span-dynamic"])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("span-unregistered", 6), ("span-dynamic", 7)]
+    assert "mystery.phase" in fs[0].message
+
+
+def test_span_dynamic_annotation_and_span_at(tmp_path):
+    # the annotation names a registered pattern; span_at sites are
+    # checked exactly like span sites; the defining module is exempt
+    fs = lint(tmp_path, {
+        "spans.py": SPANS_FIXTURE,
+        "x.py": """
+            import spans
+
+            # dklint: spans=perf.*
+            spans.span(name)
+            spans.span_at("train.run", None, 0, 1)
+            spans.span_at("nope", None, 0, 1)
+        """}, rules=["span-unregistered", "span-dynamic"])
+    assert [(f.rule, f.line) for f in fs] == [("span-unregistered", 7)]
+    assert "nope" in fs[0].message
+
+
 def test_syntax_error_rule_survives_rules_filter(tmp_path):
     (tmp_path / "broken.py").write_text("def f(:\n")
     (tmp_path / "ok.py").write_text("x = 1\n")
@@ -1418,7 +1466,10 @@ def test_rule_docs_complete():
         "knob-undocumented", "knob-doc-drift", "event-unregistered",
         "event-dynamic", "event-undocumented", "event-doc-drift",
         "metric-unregistered", "metric-dynamic", "metric-collision",
-        "metric-undocumented", "metric-doc-drift", "signal-unsafe",
+        "metric-undocumented", "metric-doc-drift",
+        # round 16: the span-vocabulary registry
+        "span-unregistered", "span-dynamic",
+        "signal-unsafe",
         "obs-must-not-raise", "broad-except", "untyped-raise",
         "jit-impure",
         # round 15: the concurrency pass + doc/waiver hygiene

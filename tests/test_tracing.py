@@ -1,0 +1,565 @@
+"""Distributed tracing (round 16): trace context across threads and
+processes, the flight recorder + crash/preempt/watchdog dumps, the
+/tracez + /statusz endpoints, and the Perfetto export."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.observability import (
+    events,
+    flight,
+    metrics,
+    report,
+    spans,
+    statusz,
+    trace_export,
+)
+
+
+@pytest.fixture
+def obs_dir(tmp_path, monkeypatch):
+    """Event log + flight recorder into a temp dir; full reset both
+    ways so other tests keep the disabled fast path."""
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    events.reset()
+    metrics.reset()
+    flight.reset()
+    spans.reset()
+    yield d
+    events.reset()
+    metrics.reset()
+    flight.reset()
+    spans.reset()
+
+
+def _read(d):
+    return report.read_events(d)
+
+
+def _span_ends(recs, name=None):
+    return [e for e in recs if e.get("kind") == "span_end"
+            and (name is None or e.get("span") == name)]
+
+
+# ---------------------------------------------------------- trace ids
+def test_root_span_mints_trace_and_children_link(obs_dir):
+    with spans.span("train.run"):
+        with spans.span("ckpt.save", step=1):
+            pass
+    recs = _read(obs_dir)
+    root = _span_ends(recs, "train.run")[0]
+    child = _span_ends(recs, "train.run.ckpt.save")[0]
+    assert len(root["trace_id"]) == 32 and len(root["span_id"]) == 16
+    assert root["parent_id"] is None
+    assert child["trace_id"] == root["trace_id"]
+    assert child["parent_id"] == root["span_id"]
+    assert child["span_id"] != root["span_id"]
+
+
+def test_sibling_spans_get_distinct_ids_same_trace(obs_dir):
+    with spans.span("train.run"):
+        with spans.span("ckpt.save", step=1):
+            pass
+        with spans.span("ckpt.save", step=2):
+            pass
+    ends = _span_ends(_read(obs_dir), "train.run.ckpt.save")
+    assert len(ends) == 2
+    assert ends[0]["span_id"] != ends[1]["span_id"]
+    assert ends[0]["trace_id"] == ends[1]["trace_id"]
+
+
+def test_ids_deterministic_under_trace_seed(monkeypatch):
+    monkeypatch.setenv("DK_TRACE_SEED", "42")
+    spans.reset()
+    a = (spans.new_trace_id(), spans.new_span_id())
+    spans.reset()
+    b = (spans.new_trace_id(), spans.new_span_id())
+    spans.reset()
+    assert a == b
+
+
+def test_dk_trace_id_joins_the_job_trace(obs_dir, monkeypatch):
+    job = "ab" * 16
+    monkeypatch.setenv("DK_TRACE_ID", job)
+    with spans.span("train.run"):
+        pass
+    root = _span_ends(_read(obs_dir), "train.run")[0]
+    assert root["trace_id"] == job
+    assert root["parent_id"] is None
+
+
+# --------------------------------------------- cross-thread resumption
+def test_capture_resume_across_threads(obs_dir):
+    got = {}
+
+    def worker(ctx):
+        with spans.resume(ctx):
+            with spans.span("ckpt.save", step=7):
+                got["ctx"] = spans.current()
+
+    with spans.span("train.run"):
+        ctx = spans.capture()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    recs = _read(obs_dir)
+    root = _span_ends(recs, "train.run")[0]
+    child = _span_ends(recs, "ckpt.save")[0]
+    assert child["trace_id"] == root["trace_id"]
+    assert child["parent_id"] == root["span_id"]
+    assert child["tid"] != root["tid"]
+    assert got["ctx"].trace_id == root["trace_id"]
+
+
+def test_resume_restores_previous_base(obs_dir):
+    ctx = spans.SpanContext("11" * 16, "22" * 8)
+    with spans.resume(ctx):
+        assert spans.current() == ctx
+    assert spans.current() is None
+    with spans.resume(None):  # no-op, never raises
+        assert spans.current() is None
+
+
+def test_span_at_retroactive_record(obs_dir):
+    ctx = spans.SpanContext("cd" * 16, "ef" * 8)
+    t1 = time.time()
+    out = spans.span_at("serve.queue_wait", ctx, t1 - 0.5, t1, rung=8)
+    (ev,) = _span_ends(_read(obs_dir), "serve.queue_wait")
+    assert ev["trace_id"] == ctx.trace_id
+    assert ev["parent_id"] == ctx.span_id
+    assert ev["span_id"] == out.span_id
+    assert ev["t0"] == pytest.approx(t1 - 0.5)
+    assert ev["duration_s"] == pytest.approx(0.5)
+
+
+def test_events_auto_stamped_with_open_span_context(obs_dir):
+    with spans.span("train.run"):
+        events.emit("chunk", i=0)
+    events.emit("chunk", i=1)  # outside: no stamping
+    recs = _read(obs_dir)
+    root = _span_ends(recs, "train.run")[0]
+    inside = [e for e in recs if e.get("kind") == "chunk"
+              and e.get("i") == 0][0]
+    outside = [e for e in recs if e.get("kind") == "chunk"
+               and e.get("i") == 1][0]
+    assert inside["trace_id"] == root["trace_id"]
+    assert inside["span_id"] == root["span_id"]
+    assert "trace_id" not in outside
+
+
+# ----------------------------------------------------- traceparent
+def test_traceparent_round_trip():
+    ctx = spans.SpanContext("0af7651916cd43dd8448eb211c80319c",
+                            "b7ad6b7169203331")
+    header = spans.traceparent(ctx)
+    assert header == ("00-0af7651916cd43dd8448eb211c80319c-"
+                      "b7ad6b7169203331-01")
+    back = spans.parse_traceparent(header)
+    assert back == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-b7ad6b7169203331-01",
+    "00-0af7651916cd43dd8448eb211c80319c-xyz-01",
+    "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331",
+])
+def test_traceparent_malformed_is_none(bad):
+    assert spans.parse_traceparent(bad) is None
+
+
+def test_serving_request_trace_through_real_http(obs_dir):
+    # handler -> batcher -> replica: the full serving lifecycle must be
+    # ONE connected trace, continued from the client's traceparent and
+    # echoed back on the response
+    from urllib import request as rq
+
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.serving import ServingEngine, ServingServer
+
+    eng = ServingEngine(mnist_mlp(hidden=(8,), input_dim=4,
+                                  num_classes=2),
+                        replicas=1, batch_ladder=(1, 4),
+                        max_latency_s=0.002)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    try:
+        # a REAL client-side root span (emitted, so the server-side
+        # spans' parent exists in the merged record set)
+        with spans.span("serve.client"):
+            client = spans.capture()
+            req = rq.Request(
+                f"http://{host}:{port}/predict",
+                data=json.dumps(
+                    {"rows": [[0.1, 0.2, 0.3, 0.4]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": spans.traceparent(client)})
+            with rq.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                echo = spans.parse_traceparent(
+                    resp.headers.get("traceparent"))
+        assert echo is not None and echo.trace_id == client.trace_id
+    finally:
+        srv.close()
+    recs = _read(obs_dir)
+    request = _span_ends(recs, "serve.request")[0]
+    assert request["trace_id"] == client.trace_id
+    assert request["parent_id"] == client.span_id
+    assert echo.span_id == request["span_id"]
+    for stage in ("serve.queue_wait", "serve.exec"):
+        (ev,) = _span_ends(recs, stage)
+        assert ev["trace_id"] == client.trace_id, stage
+        assert ev["parent_id"] == request["span_id"], stage
+        assert ev["tid"] != request["tid"], stage  # the thread handoff
+    ct = trace_export.connected_traces(recs)
+    row = ct[client.trace_id]
+    assert row["connected"] and row["cross_thread"] >= 1
+
+
+def test_async_ckpt_save_joins_callers_trace(obs_dir, tmp_path):
+    from dist_keras_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "ck"))
+    with spans.span("train.run"):
+        ck.save(1, {"w": np.zeros((8, 8), np.float32)}).wait(
+            timeout_s=30)
+    recs = _read(obs_dir)
+    root = _span_ends(recs, "train.run")[0]
+    save = _span_ends(recs, "ckpt.save")[0]
+    assert save["trace_id"] == root["trace_id"]
+    assert save["parent_id"] == root["span_id"]
+    assert save["tid"] != root["tid"]  # it ran on the writer thread
+
+
+# --------------------------------------------------- zero-cost contract
+def test_disabled_span_is_shared_noop(monkeypatch):
+    monkeypatch.delenv("DK_OBS_DIR", raising=False)
+    events.reset()
+    spans.reset()
+    assert spans.span("x") is spans.span("y")
+    with spans.span("x") as p:
+        assert p == ""
+    assert spans.capture() is None
+    assert spans.span_at("serve.exec", None, 0.0, 1.0) is None
+    assert spans.traceparent() is None
+
+
+def test_disabled_span_allocates_nothing(monkeypatch):
+    import gc
+
+    monkeypatch.delenv("DK_OBS_DIR", raising=False)
+    events.reset()
+    spans.reset()
+    for _ in range(100):
+        with spans.span("x"):
+            pass
+    gc.collect()
+    b0 = sys.getallocatedblocks()
+    for _ in range(5000):
+        with spans.span("x"):
+            pass
+    assert sys.getallocatedblocks() - b0 < 8
+
+
+# ------------------------------------------------------ flight recorder
+def test_ring_is_bounded_oldest_evicted(monkeypatch):
+    monkeypatch.setenv("DK_TRACE_RING", "16")
+    rec = flight.FlightRecorder()
+    for i in range(40):
+        rec.record({"seq": i})
+    got = rec.records()
+    assert len(got) == 16
+    assert got[0]["seq"] == 24 and got[-1]["seq"] == 39
+
+
+def test_dump_on_demand_and_event(obs_dir):
+    events.emit("chunk", i=0)
+    path = flight.dump("manual", why="test")
+    assert path and os.path.exists(path)
+    doc = flight.load_dump(path)
+    assert doc["reason"] == "manual"
+    assert doc["fields"] == {"why": "test"}
+    assert any(r.get("kind") == "chunk" for r in doc["records"])
+    recs = _read(obs_dir)
+    (ev,) = [e for e in recs if e.get("kind") == "flight_dump"]
+    assert ev["path"] == path and ev["reason"] == "manual"
+    assert metrics.counter("flight.dumps").value >= 1
+
+
+def test_dump_disabled_returns_none(monkeypatch):
+    monkeypatch.delenv("DK_OBS_DIR", raising=False)
+    events.reset()
+    flight.reset()
+    assert flight.dump("manual") is None
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_thread_crash_dumps_via_excepthook(obs_dir):
+    # a REAL unhandled exception on a thread: threading.excepthook is
+    # chained by attach() (which ran when the event log resolved)
+    from dist_keras_tpu.resilience import faults
+    from dist_keras_tpu.resilience.faults import FaultInjected
+
+    events.emit("chunk", i=0)  # resolve the writer -> hooks armed
+
+    def boom():
+        with faults.armed("step.loss"):
+            faults.fault_point("step.loss")
+
+    t = threading.Thread(target=boom, name="crash-me")
+    t.start()
+    t.join()
+    dumps = flight.dump_files(obs_dir)
+    assert dumps, "no crash dump written"
+    doc = flight.load_dump(dumps[0])
+    assert doc["reason"] == "crash"
+    assert doc["fields"]["error"] == FaultInjected.__name__
+    assert doc["fields"]["where"] == "crash-me"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_systemexit_is_not_a_crash(obs_dir):
+    events.emit("chunk", i=0)
+
+    def leave():
+        raise SystemExit(0)
+
+    t = threading.Thread(target=leave)
+    t.start()
+    t.join()
+    assert not [p for p in flight.dump_files(obs_dir)
+                if "crash" in os.path.basename(p)]
+
+
+def test_preempt_watcher_dumps(obs_dir):
+    from dist_keras_tpu.resilience import preemption
+
+    events.emit("chunk", i=0)
+    done = threading.Event()
+    stop = preemption.on_request(lambda s: done.set(), poll_s=0.01)
+    try:
+        preemption.request(signal.SIGTERM)
+        assert done.wait(10)
+    finally:
+        stop()
+        preemption.clear()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        paths = [p for p in flight.dump_files(obs_dir)
+                 if "preempt" in os.path.basename(p)]
+        if paths:
+            break
+        time.sleep(0.01)
+    assert paths, "preemption watcher never dumped"
+    assert flight.load_dump(paths[0])["fields"]["signum"] == \
+        signal.SIGTERM
+
+
+def test_watchdog_alert_dumps_and_names_the_path(obs_dir):
+    from dist_keras_tpu.observability.watchdog import Rule, Watchdog
+
+    events.emit("chunk", i=0)
+
+    class Fire(Rule):
+        name = "always"
+
+        def evaluate(self, now):
+            return True, {"metric": "x"}
+
+    seen = {}
+    wd = Watchdog(rules=[Fire()], alert_sink=seen.update)
+    fired = wd.check()
+    assert fired and "dump_path" in fired[0]
+    assert os.path.exists(fired[0]["dump_path"])
+    # the sink payload (what DK_ALERT_CMD receives) carries it too
+    assert seen["dump_path"] == fired[0]["dump_path"]
+    recs = _read(obs_dir)
+    (alert,) = [e for e in recs if e.get("kind") == "watchdog_alert"]
+    assert alert["dump_path"] == fired[0]["dump_path"]
+
+
+def test_read_dumps_dedupes_and_merges(obs_dir):
+    events.emit("chunk", i=0)
+    flight.dump("one")
+    events.emit("chunk", i=1)
+    flight.dump("two")  # overlaps dump one's records
+    recs = flight.read_dumps(obs_dir)
+    keys = [(r["rank"], r["seq"]) for r in recs]
+    assert len(keys) == len(set(keys)), "duplicate records survived"
+    chunk_is = [r["i"] for r in recs if r.get("kind") == "chunk"]
+    assert chunk_is == [0, 1]
+
+
+def test_read_dumps_keeps_both_incarnations(obs_dir):
+    # a supervised relaunch restarts the event-writer seq at 0 in a
+    # NEW process: same (rank, seq) keys, different pids — neither the
+    # dump filename nor the dedup may collapse the two incarnations
+    events.emit("chunk", i=0)
+    path1 = flight.dump("preempt")
+    doc = flight.load_dump(path1)
+    doc["pid"] = doc["pid"] + 1  # forge incarnation 2
+    doc["records"] = [dict(r, i=99) for r in doc["records"]]
+    forged = path1.replace(f"-p{os.getpid()}-", f"-p{os.getpid() + 1}-")
+    assert forged != path1  # pid-stamped name: no overwrite
+    with open(forged, "w") as f:
+        json.dump(doc, f)
+    recs = flight.read_dumps(obs_dir)
+    chunk_is = sorted(r["i"] for r in recs if r.get("kind") == "chunk")
+    assert chunk_is == [0, 99], "an incarnation's records were dropped"
+
+
+# ----------------------------------------------------- /statusz /tracez
+def test_statusz_shared_renderer_fields(obs_dir):
+    with spans.span("train.run"):
+        doc = statusz.status_doc(extra={"engine": {"pending": 0}})
+    assert doc["build"]["python"]
+    assert doc["knobs"]["DK_TRACE_RING"]["value"] == 2048
+    assert doc["knobs"]["DK_OBS_DIR"]["set"] is True
+    assert any(v == "train.run" for v in doc["spans"].values())
+    assert doc["flight"]["capacity"] >= 16
+    assert doc["engine"] == {"pending": 0}
+    json.loads(statusz.render())  # the rendered body is valid JSON
+
+
+def test_exporter_serves_statusz_and_tracez(obs_dir):
+    from urllib import request as rq
+
+    from dist_keras_tpu.observability.prometheus import Exporter
+
+    events.emit("chunk", i=0)
+    exp = Exporter(port=0, host="127.0.0.1")
+    host, port = exp.start()
+    try:
+        with rq.urlopen(f"http://{host}:{port}/statusz",
+                        timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert "DK_TRACE_RING" in doc["knobs"]
+        with rq.urlopen(f"http://{host}:{port}/tracez",
+                        timeout=10) as r:
+            tz = json.loads(r.read().decode())
+        assert tz["n"] >= 1
+        assert any(rec["kind"] == "chunk" for rec in tz["records"])
+    finally:
+        exp.close()
+
+
+# ------------------------------------------------------ Perfetto export
+def _synthetic_trace():
+    tr = "aa" * 16
+    root = {"kind": "span_end", "span": "serve.request", "t": 100.0,
+            "seq": 0, "rank": 0, "tid": 1, "trace_id": tr,
+            "span_id": "r" * 16, "parent_id": None, "duration_s": 0.5}
+    child = {"kind": "span_end", "span": "serve.exec", "t": 100.4,
+             "seq": 1, "rank": 1, "tid": 2, "trace_id": tr,
+             "span_id": "c" * 16, "parent_id": "r" * 16,
+             "duration_s": 0.1, "t0": 100.3}
+    instant = {"kind": "chunk", "t": 100.2, "seq": 2, "rank": 0, "i": 3}
+    return tr, [root, child, instant]
+
+
+def test_chrome_trace_schema_is_perfetto_loadable():
+    tr, recs = _synthetic_trace()
+    doc = trace_export.chrome_trace(recs)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    json.dumps(doc)  # serializable as-is
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 2
+    for e in slices:
+        assert {"name", "cat", "pid", "tid", "ts", "dur",
+                "args"} <= set(e)
+        assert isinstance(e["ts"], float) and e["dur"] >= 1.0
+    root = [e for e in slices if e["name"] == "serve.request"][0]
+    assert root["ts"] == pytest.approx((100.0 - 0.5) * 1e6)
+    child = [e for e in slices if e["name"] == "serve.exec"][0]
+    assert child["ts"] == pytest.approx(100.3 * 1e6)  # explicit t0 wins
+    # cross-rank parent edge -> one flow s/f pair keyed by the child
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert all(e["id"] == "c" * 16 for e in flows)
+    # metadata + the instant
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in doc["traceEvents"])
+    assert any(e["ph"] == "i" and e["name"] == "chunk"
+               for e in doc["traceEvents"])
+
+
+def test_connected_traces_flags_orphans():
+    tr, recs = _synthetic_trace()
+    row = trace_export.connected_traces(recs)[tr]
+    assert row["connected"] and row["roots"] == ["serve.request"]
+    assert row["cross_rank"] == 1
+    recs[1]["parent_id"] = "missing!"
+    row = trace_export.connected_traces(recs)[tr]
+    assert not row["connected"]
+    assert row["orphans"] == ["serve.exec"]
+
+
+def test_cli_perfetto_and_traces(obs_dir, tmp_path, capsys):
+    from dist_keras_tpu.observability.__main__ import main
+
+    with spans.span("train.run"):
+        pass
+    flight.dump("manual")
+    out = tmp_path / "trace.json"
+    assert main([str(obs_dir), "--perfetto", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    assert main([str(obs_dir), "--traces"]) == 0
+    assert "train.run" in capsys.readouterr().out
+    # --dumps sources from the recorder dumps instead
+    out2 = tmp_path / "dump_trace.json"
+    assert main([str(obs_dir), "--dumps", "--perfetto",
+                 str(out2)]) == 0
+    doc2 = json.loads(out2.read_text())
+    assert any(e["ph"] == "X" and e["name"] == "train.run"
+               for e in doc2["traceEvents"])
+
+
+def test_trainer_run_is_traced(obs_dir):
+    from dist_keras_tpu.data import Dataset
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.trainers import SingleTrainer
+    from dist_keras_tpu.utils.misc import one_hot
+
+    rng = np.random.default_rng(0)
+    n = 128
+    y = rng.integers(0, 2, n)
+    ds = Dataset({"features": rng.normal(size=(n, 8)).astype(np.float32),
+                  "label": y, "label_encoded": one_hot(y, 2)})
+    SingleTrainer(mnist_mlp(hidden=(8,), input_dim=8, num_classes=2),
+                  batch_size=64, num_epoch=1,
+                  label_col="label_encoded").train(ds)
+    recs = _read(obs_dir)
+    (root,) = _span_ends(recs, "train.run")
+    chunks = [e for e in recs if e.get("kind") == "chunk"]
+    assert chunks, "no chunk breadcrumbs"
+    for c in chunks:  # breadcrumbs stitch into the run's trace
+        assert c["trace_id"] == root["trace_id"]
+    row = trace_export.connected_traces(recs)[root["trace_id"]]
+    assert row["connected"]
+
+
+def test_job_exports_trace_id(tmp_path, monkeypatch):
+    from dist_keras_tpu.launch.job import Job
+
+    monkeypatch.setenv("DK_TRACE_SEED", "3")
+    spans.reset()
+    job = Job("s", "j", str(tmp_path), hosts=["h0", "h1"],
+              obs_dir="/tmp/obs", dry_run=True)
+    env0 = job.host_env(0)
+    env1 = job.host_env(1)
+    assert env0["DK_TRACE_ID"] == job.trace_id == env1["DK_TRACE_ID"]
+    assert len(job.trace_id) == 32
+    with pytest.raises(ValueError):
+        Job("s", "j", str(tmp_path), hosts=["h0"], dry_run=True,
+            trace_id="not-hex")
+    spans.reset()
