@@ -9,7 +9,8 @@
 - ``untyped-raise``: modules with a typed-error contract (coordination:
   ``PeerLost``/``BarrierTimeout``/``CoordinatorPoisoned``; checkpoint:
   ``CheckpointCorrupt``; serving: ``Overloaded``; supervisor:
-  ``CrashLoop``) must not grow new ``raise RuntimeError``/``raise
+  ``CrashLoop``; ps: ``StaleCommit``/``PSUnavailable``) must not grow
+  new ``raise RuntimeError``/``raise
   Exception`` sites — an untyped error is exactly what the supervisor
   cannot classify.  Deliberate fatal RuntimeErrors are waived in place
   with their rationale.
@@ -32,7 +33,7 @@ from dist_keras_tpu.analysis.core import Finding, is_broad_handler
 _TYPED_ERROR_BASENAMES = {"coordination.py", "supervisor.py",
                           "preemption.py", "backend.py",
                           "checkpoint.py"}
-_TYPED_ERROR_SUBTREES = ("serving/",)
+_TYPED_ERROR_SUBTREES = ("serving/", "ps/")
 _UNTYPED = {"Exception", "RuntimeError"}
 
 _TIME_IMPURE = {"time", "time_ns", "perf_counter", "perf_counter_ns",

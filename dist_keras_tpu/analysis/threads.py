@@ -52,6 +52,10 @@ KNOWN_THREAD_ROOTS = {
     # telemetry plane (round 11)
     "obs.sampler": "observability/timeseries.py:MetricsSampler._loop",
     "obs.exporter": "~observability/prometheus.py:_Handler.*",
+    # parameter-server training mode (round 17)
+    "ps.http": "ps/server.py:PSServer.serve_forever",
+    "ps.http_handler": "~ps/server.py:_Handler.*",
+    "ps.lease_reaper": "ps/server.py:PSServer._reaper_loop",
 }
 
 # Declared-safe lock orderings: (outer, inner) pairs asserted ONCE, so

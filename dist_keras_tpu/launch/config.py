@@ -69,6 +69,12 @@ class JobConfig:
     # DK_OBS_SAMPLE_S — the MetricsSampler/watchdog cadence in seconds
     metrics_port: int | None = None
     obs_sample_s: float | None = None
+    # parameter-server training mode: ps_addr ("host:port") exports
+    # DK_PS_ADDR on every host so PSWorkerTrainer(server_addr=None)
+    # finds the center-variable server; ps_window exports DK_PS_WINDOW
+    # (the workers' default communication window)
+    ps_addr: str | None = None
+    ps_window: int | None = None
     # job-wide trace id (32 hex chars), exported as DK_TRACE_ID with
     # the event log so every host's root spans join one trace; None =
     # Job mints one (deterministic under DK_TRACE_SEED)
@@ -96,6 +102,8 @@ class JobConfig:
               "serve_port": (int, type(None)),
               "metrics_port": (int, type(None)),
               "obs_sample_s": (int, float, type(None)),
+              "ps_addr": (str, type(None)),
+              "ps_window": (int, type(None)),
               "trace_id": (str, type(None)),
               "supervise": (int, bool, dict, type(None))}
 

@@ -113,7 +113,8 @@ class Job:
                  retries=2, retry_backoff=0.5, launch_retries=0,
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
                  serve_port=None, supervise=None, metrics_port=None,
-                 obs_sample_s=None, trace_id=None):
+                 obs_sample_s=None, trace_id=None, ps_addr=None,
+                 ps_window=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -202,6 +203,23 @@ class Job:
                              else int(metrics_port))
         self.obs_sample_s = (None if obs_sample_s is None
                              else float(obs_sample_s))
+        # ps_addr: the parameter-server training plane.  When set,
+        # every host's env gets DK_PS_ADDR (host:port of the
+        # center-variable server) so an entrypoint running
+        # ps.PSWorkerTrainer(server_addr=None) finds it; ps_window
+        # exports DK_PS_WINDOW, the workers' default communication
+        # window.  The server itself is usually NOT one of the hosts —
+        # it is the driver-side process the paper's topology names.
+        if ps_addr is not None:
+            if not re.match(r"^[A-Za-z0-9._-]+:\d+$", str(ps_addr)):
+                raise ValueError(
+                    f"ps_addr {ps_addr!r} must be host:port")
+        self.ps_addr = None if ps_addr is None else str(ps_addr)
+        if ps_window is not None and int(ps_window) < 1:
+            raise ValueError(
+                f"ps_window {ps_window!r} must be >= 1 (a 0-step "
+                "window would make every worker loop forever)")
+        self.ps_window = None if ps_window is None else int(ps_window)
         # trace_id: the job-wide trace identity exported as DK_TRACE_ID
         # alongside the event log — every host's root spans join it, so
         # the merged timeline stitches the whole pod into ONE trace.
@@ -341,6 +359,11 @@ class Job:
         if self.metrics_port is not None:
             # scrape plane: the per-host Prometheus exporter binds this
             env["DK_METRICS_PORT"] = str(self.metrics_port)
+        if self.ps_addr is not None:
+            # parameter-server plane: every worker's PSClient dials this
+            env["DK_PS_ADDR"] = self.ps_addr
+        if self.ps_window is not None:
+            env["DK_PS_WINDOW"] = str(self.ps_window)
         if self.obs_sample_s is not None:
             # live-telemetry cadence: MetricsSampler + watchdog per host
             env["DK_OBS_SAMPLE_S"] = str(self.obs_sample_s)

@@ -105,6 +105,9 @@ KNOWN_EVENTS = (
     "serve_reload", "serve_reload_error", "reload_skipped_corrupt",
     "serve_listen", "serve_drain_begin", "serve_drain_signal",
     "serve_drain",
+    # parameter-server training mode (ps/)
+    "ps_pull", "ps_commit", "ps_stale_scaled",
+    "ps_worker_join", "ps_worker_lapse",
     # telemetry plane (observability/)
     "perf_sample", "watchdog_alert", "watchdog_clear",
     "metrics_exporter_listen", "flight_dump",

@@ -186,6 +186,16 @@ KNOWN_METRICS = {
     "serve.reload.errors": "counter",
     "serve.pending": "gauge",
     "serve.predict_s": "histogram",
+    # parameter-server training mode (ps/server.py)
+    "ps.pulls": "counter",
+    "ps.commits": "counter",
+    "ps.joins": "counter",
+    "ps.lapses": "counter",
+    "ps.stale_scaled": "counter",
+    "ps.rejected_stale": "counter",
+    "ps.workers": "gauge",
+    "ps.clock": "gauge",
+    "ps.staleness": "histogram",
     # perf attribution (observability/perf.py)
     "perf.retraces": "counter",
     "perf.traces": "counter",

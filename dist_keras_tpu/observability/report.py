@@ -111,6 +111,13 @@ def summarize(events):
     dead = []         # peer-dead transitions [(rank reporting, peer)]
     resizes = []      # elastic world resizes, in timeline order
     reshards = []     # resharding restores [(rank, step, N -> M)]
+    # parameter-server attribution (ps/): per-worker commit counts,
+    # the server-side staleness histogram, membership transitions
+    ps_commits = {}   # wid -> commits applied
+    ps_staleness = {}  # staleness value -> count (the histogram)
+    ps_joins = []     # [{wid, rank, rejoined}] in timeline order
+    ps_lapses = []    # [{wid, rank, reason}] in timeline order
+    ps_rejected = 0   # over-cap commits refused (typed StaleCommit)
     nonfinite = 0
     for ev in events:
         rank = int(ev.get("rank", 0))
@@ -171,6 +178,23 @@ def summarize(events):
                 "new_world": ev.get("new_world"),
                 "dropped_ranks": ev.get("dropped_ranks"),
                 "dropped_hosts": ev.get("dropped_hosts")})
+        elif kind == "ps_commit":
+            wid = ev.get("wid", "?")
+            ps_commits[wid] = ps_commits.get(wid, 0) + 1
+            s = ev.get("staleness")
+            if s is not None:
+                ps_staleness[int(s)] = ps_staleness.get(int(s), 0) + 1
+        elif kind == "ps_worker_join":
+            ps_joins.append({"wid": ev.get("wid"),
+                             "rank": ev.get("worker_rank"),
+                             "rejoined": bool(ev.get("rejoined"))})
+        elif kind == "ps_worker_lapse":
+            ps_lapses.append({"wid": ev.get("wid"),
+                              "rank": ev.get("worker_rank"),
+                              "reason": ev.get("reason")})
+        elif kind == "ps_stale_scaled":
+            if ev.get("rejected"):
+                ps_rejected += 1
         elif kind == "reshard_restore":
             reshards.append({
                 "rank": rank, "step": ev.get("step"),
@@ -200,6 +224,10 @@ def summarize(events):
         "peer_dead": dead,
         "elastic_resizes": resizes,
         "reshard_restores": reshards,
+        "ps": {"commits_by_worker": ps_commits,
+               "staleness_hist": ps_staleness,
+               "joins": ps_joins, "lapses": ps_lapses,
+               "rejected_stale": ps_rejected},
     }
 
 
@@ -396,6 +424,33 @@ def render(directory, last_n=10):
             f"{rs['step']} written by world {rs['saved_world']} as "
             f"world {rs['world']} ({rs['n_sharded']} sharded leaves, "
             f"{rs['bytes_in']} bytes gathered)")
+    ps = s["ps"]
+    if ps["commits_by_worker"] or ps["joins"] or ps["lapses"]:
+        commits = ", ".join(
+            f"{wid} x{n}" for wid, n in
+            sorted(ps["commits_by_worker"].items()))
+        lines.append(f"parameter server: commits by worker: "
+                     f"{commits or 'none'}")
+        if ps["staleness_hist"]:
+            hist = " ".join(
+                f"{s_}:{n}" for s_, n in
+                sorted(ps["staleness_hist"].items()))
+            lines.append(f"  staleness histogram (value:count): {hist}")
+        for j in ps["joins"]:
+            lines.append(
+                f"  worker join: {j['wid']}"
+                + (f" (rank {j['rank']})" if j["rank"] is not None
+                   else "")
+                + (" [rejoin]" if j["rejoined"] else ""))
+        for lp in ps["lapses"]:
+            lines.append(
+                f"  worker lapse: {lp['wid']}"
+                + (f" (rank {lp['rank']})" if lp["rank"] is not None
+                   else "")
+                + f" — {lp['reason']}")
+        if ps["rejected_stale"]:
+            lines.append(f"  over-cap commits refused (typed): "
+                         f"{ps['rejected_stale']}")
     # the tail per host — what each host was doing when the run ended
     by_rank = {}
     for ev in events:

@@ -75,6 +75,8 @@ KNOWN_SPANS = (
     # sending its traceparent header — the gate's client worker does)
     "serve.request", "serve.batch", "serve.queue_wait", "serve.exec",
     "serve.reload", "serve.client",
+    # parameter-server commit apply (ps/server.py)
+    "ps.commit",
     # perf phases under an open device trace (observability/perf.py)
     "perf.*",
 )

@@ -1,0 +1,37 @@
+"""Parameter-server training mode (round 17).
+
+The paper's research core — the asynchronous data-parallel optimizer
+family over a driver-side parameter server — finally meets the
+multi-host runtime: a fault-tolerant center-variable server
+(:mod:`~dist_keras_tpu.ps.server`), an elastic staleness-aware worker
+mode (:mod:`~dist_keras_tpu.ps.worker`), and the RPC client with named
+retry surfaces + chaos fault points (:mod:`~dist_keras_tpu.ps.client`).
+Server-side DynSGD scaling lives in :mod:`~dist_keras_tpu.ps.center`,
+bit-parity-tested against ``trainers/dynsgd.py``.
+
+``PSWorkerTrainer`` is PEP-562 lazy: the SERVER process (center +
+server + client are numpy/stdlib-light) must not pay the jax + trainer
+stack import just for touching this package — only a process that
+actually trains loads it.
+"""
+
+from dist_keras_tpu.ps.center import (CenterVariable, PSError,
+                                      StaleCommit, apply_commit,
+                                      dynsgd_scale)
+from dist_keras_tpu.ps.client import PSClient, PSUnavailable
+from dist_keras_tpu.ps.server import PSServer
+
+__all__ = [
+    "CenterVariable", "PSError", "StaleCommit",
+    "apply_commit", "dynsgd_scale",
+    "PSClient", "PSUnavailable", "PSServer", "PSWorkerTrainer",
+]
+
+
+def __getattr__(name):
+    if name == "PSWorkerTrainer":
+        from dist_keras_tpu.ps.worker import PSWorkerTrainer
+
+        return PSWorkerTrainer
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,223 @@
+"""PS worker mode — the async trainer family over a real server.
+
+``PSWorkerTrainer`` is the multi-host counterpart of the single-host
+staggered-staleness scan (``trainers/dynsgd.py``): each worker process
+pulls the center variable, trains one **communication window** of local
+SGD steps (the same jitted ``make_model_step`` scan every trainer
+family compiles), and commits its float32 ``local - pulled`` delta
+tagged with the version it pulled.  The SERVER applies the DynSGD
+scaling ``1/(1+staleness)`` — the worker never needs to know how stale
+it is, which is exactly what makes heterogeneous speeds, restarts and
+late joins the normal case instead of a failure mode:
+
+- a **slow** worker's commits simply arrive with higher staleness and
+  are scaled down server-side;
+- a **restarted** worker re-joins (sticky ``worker_id`` or a fresh
+  one), pulls, and goes — its lease had lapsed, nothing stalled;
+- an **over-cap** commit (``DK_PS_STALENESS_CAP``) comes back as a
+  typed ``StaleCommit``: the worker drops that window's delta,
+  re-pulls, and continues — bounded damage, never corruption;
+- a server restart surfaces as retried RPCs (absorbed by the named
+  ``ps.*`` retry surfaces) against the restored center; the worker
+  re-pulls and keeps going.
+
+Windows align to epoch boundaries (the last window of an epoch may be
+short), so per-epoch metrics/events keep the family contract.  The
+returned model carries the FINAL CENTER variable (the authoritative
+weights), not this worker's local replica.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_keras_tpu.trainers.base import Trainer
+from dist_keras_tpu.utils import knobs
+from dist_keras_tpu.ps.center import StaleCommit
+from dist_keras_tpu.ps.client import PSClient
+
+
+def _float_leaf(a):
+    return jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+
+
+def _merge_center(center, local):
+    """Adopt the pulled center for float leaves, keep local for the
+    rest (integer leaves are RNG state — the ``tree_merge_floats``
+    exemption policy, same as the committing workers' pull in
+    ``dynsgd.py``)."""
+    return jax.tree.map(
+        lambda c, l: jnp.asarray(np.asarray(c)).astype(l.dtype)
+        if _float_leaf(l) else l,
+        center, local)
+
+
+def _pulled_f32(params):
+    """Host float32 snapshot of the float leaves — the ``pulled``
+    reference the window delta subtracts from."""
+    return jax.tree.map(
+        lambda l: (np.asarray(l, dtype=np.float32) if _float_leaf(l)
+                   else None),
+        params)
+
+
+def _window_delta(local, pulled):
+    """The committed payload: float32 ``local - pulled`` per float
+    leaf (the exact worker-side expression of the dynsgd commit),
+    zeros elsewhere."""
+    return jax.tree.map(
+        lambda l, p: (np.asarray(l, dtype=np.float32) - p
+                      if p is not None else np.zeros((), np.int32)),
+        local, pulled)
+
+
+class PSWorkerTrainer(Trainer):
+    """One elastic async worker against a center-variable server.
+
+    ``server_addr`` defaults to the launcher-exported ``DK_PS_ADDR``;
+    ``communication_window`` to the server's configured window (what
+    the join response reports), else ``DK_PS_WINDOW``.  ``worker_id``
+    makes the lease sticky across restarts (a supervisor relaunch with
+    the same id re-joins in place); None mints a fresh one.
+    """
+
+    def __init__(self, keras_model, server_addr=None,
+                 communication_window=None, worker_id=None,
+                 client=None, **kw):
+        super().__init__(keras_model, **kw)
+        self.server_addr = server_addr
+        if communication_window is not None \
+                and int(communication_window) < 1:
+            raise ValueError(
+                f"communication_window {communication_window!r} must "
+                "be >= 1 (a 0-step window would loop forever "
+                "committing empty deltas)")
+        self.communication_window = (
+            None if communication_window is None
+            else int(communication_window))
+        self.worker_id = worker_id
+        self._client = client
+        self.commit_log = []  # [(version, staleness, scale)] applied
+        self.stale_rejections = 0  # over-cap commits refused typed
+
+    def _make_client(self):
+        if self._client is not None:
+            return self._client
+        return PSClient(self.server_addr)
+
+    @staticmethod
+    def _coord_rank():
+        """This worker's coordination rank, if the launcher exported
+        one — the identity the server's host-drop evidence lapses by."""
+        raw = knobs.raw("DK_COORD_RANK")
+        try:
+            return int(raw) if raw is not None else None
+        except ValueError:
+            return None
+
+    def train(self, dataset, shuffle=False):
+        model, loss_fn, tx = self._resolve()
+        client = self._make_client()
+        joined = client.join(wid=self.worker_id,
+                             rank=self._coord_rank())
+        self.worker_id = joined["wid"]
+        version = joined["version"]
+        W = self.communication_window or int(joined.get("window") or
+                                             knobs.get("DK_PS_WINDOW"))
+        if W < 1:
+            raise ValueError(
+                f"communication window must be >= 1, got {W} (check "
+                "communication_window= / the server's window / "
+                "DK_PS_WINDOW) — a 0-step window would loop forever "
+                "committing empty deltas")
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        xb, yb = dataset.batches(
+            self.batch_size, self.features_col, self.label_col,
+            dtype=self.data_dtype)
+        spe = xb.shape[0]  # steps per epoch
+        total_t = self.num_epoch * spe
+        xs, ys = jnp.asarray(xb), jnp.asarray(yb)
+
+        step, opt_init = self._make_step(model, loss_fn, tx)
+        params = _merge_center(joined["center"], model.params)
+        pulled = _pulled_f32(params)
+        opt_state = opt_init(params)
+        rng = jax.random.PRNGKey(self.seed)
+
+        def build_window(T):
+            # same indexed-scan construction as SingleTrainer: one
+            # continuous rng chain, global step t indexes data by
+            # t % spe — a window never depends on where epochs fall
+            @jax.jit
+            def run(params, opt_state, rng, xs, ys, t0):
+                def indexed(c, t):
+                    si = t % spe
+                    x = jax.lax.dynamic_index_in_dim(
+                        xs, si, 0, keepdims=False)
+                    y = jax.lax.dynamic_index_in_dim(
+                        ys, si, 0, keepdims=False)
+                    return step(c, (x, y))
+
+                (params, opt_state, rng), ls = jax.lax.scan(
+                    indexed, (params, opt_state, rng),
+                    jnp.arange(T) + t0)
+                return params, opt_state, rng, ls
+
+            return run
+
+        self.record_training_start()
+        history = []
+        epoch_losses = []
+        t = 0
+        epoch_t0 = time.time()
+        center = joined["center"]
+        try:
+            while t < total_t:
+                # windows align to epoch boundaries so per-epoch
+                # metrics keep the family contract
+                T = min(W, spe - (t % spe), total_t - t)
+                fn = self._compiled(lambda: build_window(T),
+                                    extra_key=("ps", T, spe))
+                params, opt_state, rng, losses = fn(
+                    params, opt_state, rng, xs, ys, jnp.int32(t))
+                losses = np.asarray(losses)
+                history.extend(losses.tolist())
+                epoch_losses.extend(losses.tolist())
+                t += T
+                # commit the window; adopt the fresh center either way
+                delta = _window_delta(params, pulled)
+                try:
+                    resp = client.commit(self.worker_id, version,
+                                         delta,
+                                         rank=self._coord_rank())
+                    self.commit_log.append(
+                        (resp["version"], resp["staleness"],
+                         resp["scale"]))
+                    version, center = resp["version"], resp["center"]
+                except StaleCommit:
+                    # over the cap: this window's delta is refused —
+                    # drop it, re-pull, keep going (bounded damage)
+                    self.stale_rejections += 1
+                    fresh = client.pull(self.worker_id)
+                    version, center = fresh["version"], fresh["center"]
+                params = _merge_center(center, params)
+                pulled = _pulled_f32(params)
+                if t % spe == 0:
+                    now = time.time()
+                    self._emit_epoch_end(
+                        t // spe, epoch_losses, now - epoch_t0,
+                        len(epoch_losses) * self.batch_size)
+                    epoch_losses = []
+                    epoch_t0 = now
+        finally:
+            self.record_training_end()
+        # the authoritative result is the CENTER, not this worker's
+        # local replica (another worker may have committed after us)
+        final = client.pull(self.worker_id)
+        final_params = _merge_center(final["center"], params)
+        return self._finalize(final_params, history)

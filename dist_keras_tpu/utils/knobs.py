@@ -190,6 +190,31 @@ _register("DK_SERVE_PORT", None, int, kind="port",
           doc="the port a launched serving job binds (exported per "
               "host by `launch.Job(serve_port=...)`)")
 
+# parameter-server training mode
+_register("DK_PS_ADDR", None, str,
+          "`host:port` of the center-variable parameter server every "
+          "PS worker talks to (exported per host by "
+          "`launch.Job(ps_addr=...)`)")
+_register("DK_PS_PORT", None, int, kind="port",
+          doc="the port a launched `PSServer(port=None)` binds")
+_register("DK_PS_WINDOW", 32, int,
+          "default communication window: local steps a PS worker "
+          "trains between pull and commit (exported per host by "
+          "`launch.Job(ps_window=...)`; an explicit "
+          "`PSWorkerTrainer(communication_window=)` wins)")
+_register("DK_PS_LEASE_S", 15.0, float, kind="seconds",
+          doc="worker lease TTL: a worker silent this long lapses out "
+              "of the server's staleness accounting (its next commit "
+              "auto-rejoins)")
+_register("DK_PS_STALENESS_CAP", 1000, int,
+          "commits staler than this many center updates are refused "
+          "with a typed `StaleCommit` (the worker re-pulls) instead "
+          "of an arbitrarily-down-scaled apply")
+_register("DK_PS_COMMIT_DEADLINE_S", 60.0, float, kind="seconds",
+          doc="overall deadline of the `ps.commit` retry surface — a "
+              "wedged server becomes a typed error at a bounded "
+              "instant, never an unbounded worker stall")
+
 
 # -- access ------------------------------------------------------------
 

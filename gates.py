@@ -85,10 +85,22 @@ to completion; the final promoted step must verify and restore
 bit-equal to the reference single-host computation, with the resize
 and reshard attributed in the merged observability report.
 
+The PS gate (``--ps-only``, round 17) is the parameter-server-mode
+acceptance: a REAL 2-worker async PS run against a live center-variable
+server where one worker is SIGKILLed mid-run and a replacement joins —
+training must complete with every surviving worker's final eval
+meeting the pinned single-host DynSGD accuracy floor, the server's
+SIGTERM-drain checkpoint must verify and restore bit-equal to the
+center it printed, and the merged observability report must attribute
+the killed worker's lapse and every join.  A seeded chaos sweep over
+the ``ps.pull`` / ``ps.commit`` / ``ps.join`` fault points rides
+along: every run ends completed or typed with a verified promoted
+center-variable step — never a hang.
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
                         [--serving-only] [--chaos-only]
-                        [--elastic-only]
+                        [--elastic-only] [--ps-only]
 """
 
 from __future__ import annotations
@@ -2099,6 +2111,393 @@ def run_coordination_gate(timeout=180):
     }
 
 
+# The deterministic sorted-path tree sha BOTH PS gate scripts use —
+# the server prints it at drain, the check worker recomputes it from
+# the promoted checkpoint alone; one definition, spliced into both
+# scripts, so the bit-equality verdict can never drift between them.
+_PS_TREE_SHA = r"""
+import hashlib
+import numpy as np
+
+
+def tree_sha(tree):
+    h = hashlib.sha256()
+
+    def walk(t, path):
+        if isinstance(t, dict):
+            for k in sorted(t):
+                walk(t[k], path + (str(k),))
+        elif isinstance(t, (list, tuple)):
+            for i, v in enumerate(t):
+                walk(v, path + (str(i),))
+        else:
+            h.update("/".join(path).encode())
+            h.update(np.asarray(t).tobytes())
+
+    walk(tree, ())
+    return h.hexdigest()
+"""
+
+
+# The PS gate's center-variable server process: binds a free port,
+# publishes host:port atomically, serves until the parent's SIGTERM —
+# the preemption-path drain then takes the FINAL center checkpoint
+# (waited: the durability barrier) before the process exits 143, and
+# the PS_FINAL line names the commit clock + a deterministic sha the
+# check worker must reproduce from the PROMOTED checkpoint alone.
+_PS_SERVER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+work = sys.argv[1]
+sys.path.insert(0, %REPO%)
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.ps import PSServer
+from dist_keras_tpu.resilience.preemption import Preempted
+%TREE_SHA%
+
+os.makedirs(work, exist_ok=True)
+srv = PSServer(
+    params=mnist_mlp(hidden=(16,), input_dim=8, num_classes=2,
+                     seed=0).params,
+    port=0, window=4, ckpt_dir=os.path.join(work, "ck"),
+    ckpt_every_commits=4)
+srv.install_signal_drain(poll_s=0.02)
+host, port = srv.address
+tmp = os.path.join(work, ".addr.tmp")
+with open(tmp, "w") as f:
+    f.write(f"{host}:{port}")
+os.replace(tmp, os.path.join(work, "addr"))
+try:
+    srv.run_forever()
+except Preempted:
+    # the watcher-thread drain already rejected admission, saved the
+    # final center and WAITED the handle — this state IS the promoted
+    # checkpoint's content
+    clock, center = srv.center.state()
+    print("PS_FINAL", clock, tree_sha(center), flush=True)
+    raise
+"""
+
+# The PS gate's worker/check process.  "train": one elastic async
+# worker — joins, trains windows, commits, prints its accuracy against
+# the pinned DynSGD floor; every failure path must be TYPED.  "check":
+# post-mortem verifier — the server's latest PROMOTED step must verify
+# "ok" and restore bit-equal to the sha the server printed at drain,
+# and (main scenario) the merged obs report must attribute the killed
+# worker's lapse and every join.
+_PS_WORKER = r"""
+import os, sys, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+mode = sys.argv[1]
+sys.path.insert(0, %REPO%)
+%TREE_SHA%
+
+if mode == "check":
+    work, expect_path = sys.argv[2], sys.argv[3]
+    with open(expect_path) as f:
+        expect = json.load(f)
+    from dist_keras_tpu.checkpoint import Checkpointer
+
+    bad = []
+    ck = Checkpointer(os.path.join(work, "ck"), rank=0, world=1)
+    latest = ck.latest_step()
+    if latest is None:
+        bad.append("no promoted step at all")
+    else:
+        if latest != expect["clock"]:
+            bad.append(f"latest promoted step {latest} != drained "
+                       f"clock {expect['clock']}")
+        try:
+            if ck.verify(latest) != "ok":
+                bad.append(f"step {latest} did not verify ok")
+        except Exception as e:
+            bad.append(f"verify({latest}) raised {type(e).__name__}")
+        step, state = ck.restore(step=latest)
+        if step != latest:
+            bad.append(f"restore({latest}) fell back to {step}")
+        if int(np.asarray(state["clock"])) != expect["clock"]:
+            bad.append("restored clock mismatch")
+        sha = tree_sha(state["center"])
+        if sha != expect["sha"]:
+            bad.append(f"restored center sha {sha[:12]} != drained "
+                       f"{expect['sha'][:12]}")
+    if expect.get("obs_dir"):
+        from dist_keras_tpu.observability import report
+
+        s = report.summarize(report.read_events(expect["obs_dir"]))
+        lapsed = [lp["wid"] for lp in s["ps"]["lapses"]]
+        if expect.get("killed_wid") and \
+                expect["killed_wid"] not in lapsed:
+            bad.append(f"killed worker {expect['killed_wid']} not "
+                       f"attributed in lapses {lapsed}")
+        if len(s["ps"]["joins"]) < expect.get("min_joins", 0):
+            bad.append(f"only {len(s['ps']['joins'])} joins "
+                       f"attributed, wanted {expect.get('min_joins')}")
+        if sum(s["ps"]["commits_by_worker"].values()) < 1:
+            bad.append("no per-worker commits attributed")
+    print(("PS_CHECK_OK " + str(latest)) if not bad
+          else ("PS_CHECK_BAD " + "; ".join(bad)), flush=True)
+    sys.exit(0 if not bad else 1)
+
+# mode == "train"
+rank, addr, work = sys.argv[2], sys.argv[3], sys.argv[4]
+epochs, seed = int(sys.argv[5]), int(sys.argv[6])
+from dist_keras_tpu.data import (AccuracyEvaluator, Dataset,
+                                 LabelIndexTransformer, ModelPredictor)
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.ps import PSError, PSWorkerTrainer
+from dist_keras_tpu.resilience.faults import FaultInjected
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+n, d = 512, 8
+y = rng.integers(0, 2, size=n)
+centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+x = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+ds = Dataset({"features": x, "label": y, "label_encoded": one_hot(y, 2)})
+
+t = PSWorkerTrainer(
+    mnist_mlp(hidden=(16,), input_dim=8, num_classes=2, seed=0),
+    server_addr=addr, communication_window=4, worker_optimizer="sgd",
+    optimizer_kwargs={"learning_rate": 0.05}, batch_size=16,
+    num_epoch=epochs, label_col="label_encoded", seed=seed)
+ready = os.path.join(work, f"ready_{rank}")
+
+
+def pacing(trainer, epoch, logs):
+    # publish join identity once committed, stretch the run so the
+    # parent's SIGKILL lands mid-training
+    if not os.path.exists(ready):
+        tmp = ready + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(trainer.worker_id))
+        os.replace(tmp, ready)
+    time.sleep(0.05)
+
+
+t.callbacks.append(pacing)
+try:
+    model = t.train(ds)
+except (FaultInjected, PSError, OSError) as e:
+    print(f"TYPED {type(e).__name__}: {e}", flush=True)
+    sys.exit(2)
+pred = ModelPredictor(model, features_col="features").predict(ds)
+idx = LabelIndexTransformer(input_col="prediction").transform(pred)
+acc = AccuracyEvaluator(prediction_col="prediction_index",
+                        label_col="label").evaluate(idx)
+print("PS_WORKER_DONE", rank, t.worker_id, round(float(acc), 4),
+      len(t.commit_log), t.stale_rejections, flush=True)
+sys.exit(0)
+"""
+
+# the pinned single-host DynSGD accuracy floor (the round-10 seed-3
+# contract: DynSGD on the blobs-shaped task must clear 0.80)
+_PS_ACC_FLOOR = 0.80
+
+
+def run_ps_gate(k_chaos=4, timeout=240):
+    """-> gate record for the parameter-server training gate: (a) a
+    REAL 2-worker PS run where one worker is SIGKILLed mid-run and a
+    replacement joins — training completes, final eval meets the
+    pinned single-host DynSGD floor, the server's drain checkpoint
+    verifies + restores bit-equal, and the merged report attributes
+    the lapse + join; (b) a seeded chaos sweep over the ``ps.pull`` /
+    ``ps.commit`` / ``ps.join`` fault points — every run ends
+    completed-or-typed with a verified promoted center step, never a
+    hang."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_ps_gate_")
+    server_script = os.path.join(work, "ps_server.py")
+    worker_script = os.path.join(work, "ps_worker.py")
+    with open(server_script, "w") as f:
+        f.write(_PS_SERVER.replace("%REPO%", repr(REPO))
+                .replace("%TREE_SHA%", _PS_TREE_SHA))
+    with open(worker_script, "w") as f:
+        f.write(_PS_WORKER.replace("%REPO%", repr(REPO))
+                .replace("%TREE_SHA%", _PS_TREE_SHA))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_CKPT", "DK_ALERT", "DK_PS"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    t0 = time.time()
+    failures = []
+    chaos_runs = []
+
+    def _wait_file(path, deadline_s, procs=()):
+        t_wait = time.time()
+        while time.time() - t_wait < deadline_s:
+            if os.path.exists(path):
+                return True
+            if any(p.poll() is not None for p in procs):
+                return False
+            time.sleep(0.02)
+        return False
+
+    def _finish(p, label):
+        try:
+            return p.communicate(timeout=timeout)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            failures.append(f"{label}: HANG (killed at {timeout}s)")
+            return "HANG: " + p.communicate()[0][-300:]
+
+    def _spawn_server(run_dir, env_extra=None):
+        env = dict(base_env)
+        env["DK_COORD_RANK"] = "0"  # event-log rank for the server
+        env.update(env_extra or {})
+        p = subprocess.Popen(
+            [sys.executable, server_script, run_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True)
+        if not _wait_file(os.path.join(run_dir, "addr"), 90,
+                          procs=(p,)):
+            failures.append("server never published its address")
+            p.kill()
+            p.communicate()
+            return None, None
+        with open(os.path.join(run_dir, "addr")) as f:
+            return p, f.read().strip()
+
+    def _stop_server(p, label):
+        p.send_signal(_signal.SIGTERM)
+        out = _finish(p, label)
+        if p.returncode != 143:
+            failures.append(
+                f"{label}: server exited {p.returncode}, wanted 143 "
+                f"(SIGTERM drain): {out[-300:]}")
+        m = re.search(r"^PS_FINAL (\d+) ([0-9a-f]{64})$", out, re.M)
+        if not m:
+            failures.append(f"{label}: no PS_FINAL line: {out[-300:]}")
+            return None
+        return {"clock": int(m.group(1)), "sha": m.group(2)}
+
+    def _check(run_dir, expect, label):
+        exp_path = os.path.join(run_dir, "expect.json")
+        with open(exp_path, "w") as f:
+            json.dump(expect, f)
+        p = subprocess.Popen(
+            [sys.executable, worker_script, "check", run_dir, exp_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=dict(base_env), text=True)
+        out = _finish(p, f"{label} check")
+        if p.returncode != 0 or "PS_CHECK_OK" not in out:
+            failures.append(f"{label}: {out.strip()[-300:]}")
+        return out
+
+    try:
+        # --- (a) elastic kill + replacement ------------------------
+        run_dir = os.path.join(work, "main")
+        obs_dir = os.path.join(run_dir, "obs")
+        os.makedirs(obs_dir, exist_ok=True)
+        server, addr = _spawn_server(
+            run_dir, {"DK_OBS_DIR": obs_dir, "DK_PS_LEASE_S": "1.0"})
+        if server is not None:
+            def _worker(rank, epochs, seed):
+                env = dict(base_env)
+                env["DK_OBS_DIR"] = obs_dir
+                env["DK_COORD_RANK"] = str(rank)
+                return subprocess.Popen(
+                    [sys.executable, worker_script, "train", str(rank),
+                     addr, run_dir, str(epochs), str(seed)],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    env=env, text=True)
+
+            w1 = _worker(1, 8, 1)
+            w2 = _worker(2, 8, 2)
+            killed_wid = None
+            # SIGKILL worker 2 once it has joined and committed (its
+            # ready file names its lease id) — mid-run, not at the edge
+            if _wait_file(os.path.join(run_dir, "ready_2"), 120,
+                          procs=(w2,)):
+                with open(os.path.join(run_dir, "ready_2")) as f:
+                    killed_wid = f.read().strip()
+                w2.send_signal(_signal.SIGKILL)
+                w2.communicate()
+            else:
+                failures.append("worker 2 never became ready to kill")
+            # the replacement joins the already-advanced run
+            w3 = _worker(3, 4, 3)
+            for label, p in (("worker 1", w1), ("worker 3", w3)):
+                out = _finish(p, label)
+                m = re.search(r"^PS_WORKER_DONE \d+ (\S+) ([0-9.]+)",
+                              out, re.M)
+                if p.returncode != 0 or not m:
+                    failures.append(f"{label}: rc={p.returncode}: "
+                                    f"{out.strip()[-300:]}")
+                elif float(m.group(2)) < _PS_ACC_FLOOR:
+                    failures.append(
+                        f"{label}: accuracy {m.group(2)} below the "
+                        f"pinned DynSGD floor {_PS_ACC_FLOOR}")
+            # let the killed worker's lease lapse and the reaper emit
+            # the attribution before the server drains
+            time.sleep(2.5)
+            final = _stop_server(server, "main")
+            if final is not None:
+                _check(run_dir, {**final, "obs_dir": obs_dir,
+                                 "killed_wid": killed_wid,
+                                 "min_joins": 3}, "main")
+
+        # --- (b) seeded chaos sweep over the ps.* fault points -----
+        for seed in range(k_chaos):
+            label = f"chaos seed {seed}"
+            run_dir = os.path.join(work, f"chaos_{seed}")
+            os.makedirs(run_dir, exist_ok=True)
+            server, addr = _spawn_server(run_dir)
+            if server is None:
+                continue
+            env = dict(base_env)
+            env["DK_COORD_RANK"] = "1"
+            env["DK_FAULTS_SEED"] = str(7000 + seed)
+            env["DK_FAULTS_POINTS"] = "ps.pull,ps.commit,ps.join"
+            # rate 1.0: every point ARMS in every run (the seed still
+            # draws WHERE it fires and whether it is a retryable
+            # OSError or a permanent kill) — a sweep where nothing
+            # fires would prove nothing
+            env["DK_FAULTS_RATE"] = "1.0"
+            p = subprocess.Popen(
+                [sys.executable, worker_script, "train", "1", addr,
+                 run_dir, "2", str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, text=True)
+            out = _finish(p, label)
+            verdict = {"seed": seed, "rc": p.returncode}
+            if p.returncode == 0 and "PS_WORKER_DONE" not in out:
+                failures.append(f"{label}: exited 0 without "
+                                f"completing: {out[-200:]}")
+            if p.returncode not in (0, 2):
+                failures.append(f"{label}: worker died UNTYPED "
+                                f"(rc={p.returncode}): {out[-300:]}")
+            verdict["outcome"] = ("completed" if p.returncode == 0
+                                  else out.strip().splitlines()[-1][:80]
+                                  if out.strip() else "?")
+            final = _stop_server(server, label)
+            if final is not None:
+                verdict["promoted_clock"] = final["clock"]
+                _check(run_dir, final, label)
+            verdict["ok"] = not any(f.startswith(label)
+                                    for f in failures)
+            chaos_runs.append(verdict)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "ps_training",
+        "metric": "elastic_async_ps_completes_typed_and_bit_equal",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "accuracy_floor": _PS_ACC_FLOOR,
+        "chaos_runs": chaos_runs,
+        "failures": failures,
+    }
+
+
 def run_gates(fast=False, timeout=3 * 3600):
     cmd = [sys.executable, "-m", "pytest", "tests/test_examples.py",
            "-q", "-s", "-p", "no:cacheprovider"]
@@ -2154,6 +2553,13 @@ def main():
                          "(python -m dist_keras_tpu.analysis over the "
                          "package, shipped baseline) and print its "
                          "record")
+    ap.add_argument("--ps-only", action="store_true",
+                    help="run just the parameter-server training gate "
+                         "(2-worker PS run with a mid-run SIGKILL + "
+                         "replacement join, DynSGD accuracy floor, "
+                         "bit-equal drain checkpoint, lapse/join "
+                         "attribution, seeded ps.* chaos sweep) and "
+                         "print its record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -2171,6 +2577,11 @@ def main():
         wd_gate = run_watchdog_gate()
         print(json.dumps(wd_gate, indent=1))
         return 0 if wd_gate["passed"] else 1
+
+    if args.ps_only:
+        ps_gate = run_ps_gate()
+        print(json.dumps(ps_gate, indent=1))
+        return 0 if ps_gate["passed"] else 1
 
     if args.chaos_only:
         chaos_gate = run_chaos_gate()
@@ -2203,6 +2614,7 @@ def main():
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_chaos_gate())
     res["gates"].append(run_elastic_gate())
+    res["gates"].append(run_ps_gate())
     res["gates"].append(run_watchdog_gate())
     res["gates"].append(run_lint_gate())
     import platform

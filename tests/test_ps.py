@@ -1,0 +1,716 @@
+"""Parameter-server training mode (dist_keras_tpu/ps/).
+
+The contract pyramid:
+
+- **Staleness math parity** — the server-side DynSGD scaling on a
+  replayed commit log is BIT-EQUAL to the single-host
+  ``trainers/dynsgd.py`` update expressions for the same sequence,
+  including a stale recommit after a simulated worker restart and the
+  rollback clamp (a commit tagged newer than a restored clock).
+- **Center-variable semantics** — versioning, leases, reaping,
+  auto-rejoin, the typed over-cap refusal.
+- **Server/client round trip** — real HTTP, typed error mapping, drain
+  semantics, checkpoint/restore resume, fault-point + retry surfaces.
+- **Worker mode end-to-end** — concurrent ``PSWorkerTrainer`` s against
+  a live server learn a real (tiny) dataset with nonzero staleness,
+  and the over-cap path re-pulls and completes.
+- **Attribution** — the merged report names per-worker commits, the
+  staleness histogram, and membership transitions.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.ps import (CenterVariable, PSClient, PSError,
+                               PSServer, PSUnavailable, PSWorkerTrainer,
+                               StaleCommit, apply_commit, dynsgd_scale)
+from dist_keras_tpu.resilience import faults
+from dist_keras_tpu.resilience.faults import FaultInjected
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"dense": {"w": rng.normal(size=(6, 4)).astype(np.float32),
+                      "b": rng.normal(size=(4,)).astype(np.float32)},
+            "seed_state": np.array([1, 2], dtype=np.uint32)}
+
+
+def _delta(seed):
+    rng = np.random.default_rng(seed)
+    return {"dense": {"w": rng.normal(size=(6, 4)).astype(np.float32),
+                      "b": rng.normal(size=(4,)).astype(np.float32)},
+            "seed_state": np.zeros((), np.int32)}
+
+
+def _float_items(tree):
+    return [("dense.w", tree["dense"]["w"]),
+            ("dense.b", tree["dense"]["b"])]
+
+
+# ---------------------------------------------------------------------
+# staleness math parity: bit-equal to the dynsgd.py update
+# ---------------------------------------------------------------------
+
+def _dynsgd_reference(center0, log):
+    """Replay a commit log through the EXACT expressions of the
+    single-host scan's commit block (``trainers/dynsgd.py``
+    ``_make_body.one_step``): eager jnp, float32, same operation
+    order — ``scale = 1/(staleness+1)``;
+    ``center = (center + scale * delta).astype(center.dtype)`` where
+    ``delta`` is the worker's float32 ``local - pulled``."""
+    ref = {k: jnp.asarray(v) for k, v in
+           dict(_float_items(center0)).items()}
+    clock = 0
+    for version, delta in log:
+        staleness = jnp.float32(max(0, clock - version))
+        scale = jnp.float32(1.0) / (staleness + jnp.float32(1.0))
+        for k, d in _float_items(delta):
+            ref[k] = (ref[k] + scale * jnp.asarray(d)).astype(
+                ref[k].dtype)
+        clock += 1
+    return ref, clock
+
+
+def test_replayed_commit_log_bit_equal_to_dynsgd_update():
+    """The tentpole parity contract: a commit log spanning staleness
+    0, 1 and 3 — including a STALE RECOMMIT after a simulated worker
+    restart (the worker re-committing a version it pulled long ago) —
+    applies bit-identically through ``CenterVariable`` and through the
+    dynsgd.py update expressions."""
+    center0 = _params(0)
+    #                 (version, delta): w0 fresh, w0 fresh, w1 stale-1,
+    # restart: w1 recommits the version it pulled BEFORE two center
+    # updates landed (staleness 3), then a fresh one
+    log = [(0, _delta(1)), (1, _delta(2)), (1, _delta(3)),
+           (0, _delta(4)), (4, _delta(5))]
+    ref, ref_clock = _dynsgd_reference(center0, log)
+
+    cv = CenterVariable(center0, staleness_cap=100)
+    stalenesses = []
+    for version, delta in log:
+        info = cv.commit("w", version, delta)
+        stalenesses.append(info["staleness"])
+    assert cv.clock == ref_clock
+    assert max(stalenesses) >= 3  # the schedule exercised the scaling
+    _, center = cv.state()
+    got = dict(_float_items(center))
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k]), got[k]), k
+    # integer leaves are RNG state, not weights: bit-untouched
+    np.testing.assert_array_equal(center["seed_state"],
+                                  center0["seed_state"])
+
+
+def test_scale_and_leaf_expressions_match_dynsgd():
+    for s in (0, 1, 2, 7, 100):
+        assert dynsgd_scale(s) == np.float32(1.0) / np.float32(s + 1.0)
+        assert dynsgd_scale(s).dtype == np.float32
+    c = np.linspace(-1, 1, 12).astype(np.float32).reshape(3, 4)
+    d = (np.arange(12, dtype=np.float32) / 7.0).reshape(3, 4)
+    want = np.asarray((jnp.asarray(c)
+                       + jnp.float32(dynsgd_scale(2))
+                       * jnp.asarray(d)).astype(c.dtype))
+    np.testing.assert_array_equal(apply_commit(c, d, dynsgd_scale(2)),
+                                  want)
+    # non-float leaves pass through untouched
+    i = np.array([3, 4], dtype=np.int64)
+    np.testing.assert_array_equal(apply_commit(i, np.zeros(2), 0.5), i)
+
+
+def test_rollback_clamp_negative_staleness_is_zero():
+    """A server restored from an older checkpoint sees commits tagged
+    NEWER than its clock (the worker pulled before the crash): raw
+    staleness is negative and must clamp to 0 — full-weight apply,
+    never a down-scale and never an error."""
+    cv = CenterVariable(_params(0), clock=2)
+    info = cv.commit("w", 10, _delta(1))  # version 10 > clock 2
+    assert info["staleness"] == 0
+    assert info["scale"] == 1.0
+    assert cv.clock == 3
+
+
+# ---------------------------------------------------------------------
+# center-variable semantics
+# ---------------------------------------------------------------------
+
+def test_over_cap_commit_refused_typed_nothing_applied():
+    cv = CenterVariable(_params(0), staleness_cap=2)
+    for i in range(4):
+        cv.commit("fresh", cv.clock, _delta(i))
+    before = cv.state()
+    with pytest.raises(StaleCommit) as ei:
+        cv.commit("old", 0, _delta(9))
+    assert ei.value.staleness == 4 and ei.value.cap == 2
+    after = cv.state()
+    assert after[0] == before[0]  # clock unchanged
+    for (k, a), (_, b) in zip(_float_items(before[1]),
+                              _float_items(after[1])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_commit_id_makes_retries_idempotent():
+    """A response-lost retry (same commit_id) must NOT double-apply:
+    the replay answers like a pull — current version + center, the
+    recorded staleness/scale, duplicate=True — and the clock does not
+    advance."""
+    cv = CenterVariable(_params(0))
+    cv.join(wid="w0", now=0.0)
+    first = cv.commit("w0", 0, _delta(1), commit_id="n:0")
+    assert not first["duplicate"] and cv.clock == 1
+    replay = cv.commit("w0", 0, _delta(1), commit_id="n:0")
+    assert replay["duplicate"] and cv.clock == 1
+    assert replay["staleness"] == first["staleness"]
+    np.testing.assert_array_equal(replay["center"]["dense"]["w"],
+                                  first["center"]["dense"]["w"])
+    # a DIFFERENT id from the same worker applies normally
+    nxt = cv.commit("w0", 1, _delta(2), commit_id="n:1")
+    assert not nxt["duplicate"] and cv.clock == 2
+    # a fresh client incarnation (new nonce) never collides
+    fresh = cv.commit("w0", 2, _delta(3), commit_id="m:0")
+    assert not fresh["duplicate"] and cv.clock == 3
+
+
+def test_lease_lifecycle_reap_and_auto_rejoin():
+    cv = CenterVariable(_params(0), lease_s=10.0)
+    wid, version, center, rejoined = cv.join(rank=1, now=0.0)
+    assert not rejoined and version == 0
+    assert cv.stats()["workers"] == 1
+    # a pull renews; at now=15 the lease (renewed at 8) is still live
+    cv.pull(wid, now=8.0)
+    assert cv.reap(now=15.0) == []
+    # silence past the TTL lapses it — WITHOUT stalling anything
+    assert cv.reap(now=30.0) == [(wid, 1)]
+    assert cv.stats()["workers"] == 0
+    # the lapsed worker's next commit auto-rejoins
+    info = cv.commit(wid, version, _delta(0), now=31.0)
+    assert info["rejoined"]
+    assert cv.stats()["workers"] == 1
+    # sticky-id rejoin reports rejoined=True
+    _, _, _, rejoined = cv.join(wid=wid, now=32.0)
+    assert rejoined
+
+
+def test_workers_by_rank_maps_host_drop_evidence():
+    cv = CenterVariable(_params(0))
+    w1, *_ = cv.join(rank=1, now=0.0)
+    w2, *_ = cv.join(rank=2, now=0.0)
+    cv.join(now=0.0)  # rankless worker is never convicted by rank
+    assert cv.workers_by_rank([1]) == [(w1, 1)]
+    assert set(cv.workers_by_rank([1, 2])) == {(w1, 1), (w2, 2)}
+    assert cv.lapse(w1) and not cv.lapse(w1)
+
+
+# ---------------------------------------------------------------------
+# server/client round trip
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def ps_server(tmp_path):
+    srv = PSServer(params=_params(0), port=0, window=4,
+                   ckpt_dir=str(tmp_path / "ck"), ckpt_every_commits=2,
+                   lease_s=30.0)
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _client(srv, **kw):
+    kw.setdefault("attempts", 2)
+    kw.setdefault("backoff", 0.01)
+    return PSClient(f"{srv.address[0]}:{srv.address[1]}", **kw)
+
+
+def test_http_join_pull_commit_round_trip(ps_server):
+    c = _client(ps_server)
+    joined = c.join(rank=7)
+    assert joined["window"] == 4 and joined["version"] == 0
+    wid = joined["wid"]
+    # the second worker joins BEFORE the first commit lands, so its
+    # own commit below arrives stale by exactly 1
+    c2 = _client(ps_server)
+    j2 = c2.join()
+    resp = c.commit(wid, joined["version"], _delta(1))
+    assert resp["version"] == 1 and resp["staleness"] == 0
+    # the commit response carries the fresh center (pull-on-commit,
+    # like dynsgd's committing workers)
+    pulled = c.pull(wid)
+    assert pulled["version"] == 1
+    np.testing.assert_array_equal(pulled["center"]["dense"]["w"],
+                                  resp["center"]["dense"]["w"])
+    r2 = c2.commit(j2["wid"], j2["version"], _delta(2))
+    assert r2["staleness"] == 1 and r2["scale"] == pytest.approx(0.5)
+
+
+def test_http_over_cap_maps_to_409_stale_commit(tmp_path):
+    srv = PSServer(params=_params(0), port=0, staleness_cap=0)
+    srv.start()
+    try:
+        c = _client(srv)
+        j = c.join()
+        c.commit(j["wid"], j["version"], _delta(1))
+        with pytest.raises(StaleCommit) as ei:
+            c.commit(j["wid"], j["version"], _delta(2))
+        assert ei.value.staleness == 1 and ei.value.cap == 0
+    finally:
+        srv.close()
+
+
+def test_structurally_foreign_delta_is_typed_400(ps_server):
+    """A worker built against a DIFFERENT model shape must get a typed
+    400 back — never a dead handler the client would misread (via the
+    aborted connection) as an unreachable server."""
+    c = _client(ps_server)
+    j = c.join()
+    bad = {"dense": {"w": np.zeros((2, 2), np.float32)}}  # wrong tree
+    with pytest.raises(PSError) as ei:
+        c.commit(j["wid"], j["version"], bad)
+    assert "400" in str(ei.value)
+    assert not isinstance(ei.value, PSUnavailable)
+    # the server stays healthy and nothing was applied
+    assert c.pull(j["wid"])["version"] == 0
+
+
+def test_corrupt_pickle_body_is_typed_400(ps_server):
+    """A truncated/garbage body (pickle.UnpicklingError) is the
+    caller's bug: typed 400, not a dead handler + closed connection
+    the client would misread as unreachable."""
+    import http.client
+
+    conn = http.client.HTTPConnection(*ps_server.address, timeout=10)
+    try:
+        conn.request("POST", "/pull", body=b"\x80notpickle",
+                     headers={"Content-Type":
+                              "application/octet-stream"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 400
+    finally:
+        conn.close()
+    # server stays healthy
+    assert _client(ps_server).pull()["version"] == 0
+
+
+def test_zero_window_rejected_everywhere(tmp_path):
+    """window=0 would make every worker's loop spin forever on empty
+    commits — rejected actionably at the server, the worker, and the
+    launch export."""
+    from dist_keras_tpu.launch.job import Job
+
+    with pytest.raises(ValueError, match="window"):
+        PSServer(params=_params(0), port=0, window=0)
+    with pytest.raises(ValueError, match="ps_window"):
+        Job("s", "j", str(tmp_path), hosts=["h0"], ps_window=0)
+    with pytest.raises(ValueError, match="communication_window"):
+        PSWorkerTrainer(
+            mnist_mlp(hidden=(4,), input_dim=8, num_classes=2,
+                      seed=0),
+            server_addr="127.0.0.1:1", communication_window=0)
+
+
+def test_ps_package_import_is_worker_lazy():
+    """Importing the package (what a SERVER process does) must not pay
+    the trainer-stack import; the worker loads on first attribute
+    access (PEP 562)."""
+    import subprocess
+    import sys as _sys
+
+    # (the ROOT package eagerly imports the trainer stack, so only
+    # ps.worker's own laziness is assertable here — the export stays
+    # decoupled for the day the root goes lazy too)
+    code = (
+        "import dist_keras_tpu.ps, sys\n"
+        "assert 'dist_keras_tpu.ps.worker' not in sys.modules\n"
+        "from dist_keras_tpu.ps import PSWorkerTrainer\n"
+        "assert 'dist_keras_tpu.ps.worker' in sys.modules\n")
+    r = subprocess.run([_sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-500:]
+
+
+def test_auto_rejoin_commit_keeps_host_drop_coverage():
+    """A lapsed worker's auto-rejoining commit re-seats its
+    coordination rank, so host-drop evidence still reaches it."""
+    cv = CenterVariable(_params(0), lease_s=5.0)
+    wid, version, _, _ = cv.join(rank=2, now=0.0)
+    assert cv.reap(now=10.0) == [(wid, 2)]     # lapsed
+    cv.commit(wid, version, _delta(0), now=11.0, rank=2)  # rejoin
+    assert cv.workers_by_rank([2]) == [(wid, 2)]
+
+
+def test_drain_stops_admission_typed_and_promotes_final_step(ps_server):
+    c = _client(ps_server)
+    j = c.join()
+    for i in range(3):
+        ver = c.commit(j["wid"], c.pull(j["wid"])["version"],
+                       _delta(i))["version"]
+    step = ps_server.drain()
+    assert step == ver == 3
+    # admission after drain is REJECTED typed: 503 -> PSUnavailable
+    # after the (short) retry budget
+    with pytest.raises(PSUnavailable):
+        c.pull(j["wid"])
+    with pytest.raises(PSUnavailable):
+        c.commit(j["wid"], ver, _delta(9))
+
+
+def test_server_restart_resumes_latest_promoted_verified_step(tmp_path):
+    ck = str(tmp_path / "ck")
+    srv = PSServer(params=_params(0), port=0, ckpt_dir=ck,
+                   ckpt_every_commits=1)
+    srv.start()
+    c = _client(srv)
+    j = c.join()
+    version = j["version"]
+    for i in range(3):
+        resp = c.commit(j["wid"], version, _delta(i))
+        version = resp["version"]
+    final_center = resp["center"]
+    assert srv.drain() == 3
+    srv.close()
+    # a NEW server process restores the promoted center bit-equal —
+    # params=None: the checkpoint is the only truth
+    srv2 = PSServer(port=0, ckpt_dir=ck)
+    try:
+        assert srv2.restored_step == 3
+        assert srv2.center.clock == 3
+        _, center = srv2.center.state()
+        np.testing.assert_array_equal(center["dense"]["w"],
+                                      final_center["dense"]["w"])
+        # a worker that pulled BEFORE the restart commits against the
+        # restored clock: rollback clamp applies at full weight
+        info = srv2.center.commit("survivor", 10, _delta(7))
+        assert info["staleness"] == 0
+    finally:
+        srv2.close()
+
+
+def test_cold_start_without_params_or_checkpoint_is_actionable(tmp_path):
+    with pytest.raises(ValueError, match="initial params"):
+        PSServer(params=None, port=0, ckpt_dir=str(tmp_path / "empty"))
+
+
+def test_healthz_metricsz(ps_server):
+    import json
+    import urllib.request
+
+    host, port = ps_server.address
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["status"] == "serving"
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metricsz", timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["ps"]["clock"] == 0
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metricsz?format=prometheus",
+            timeout=10) as r:
+        text = r.read().decode()
+    assert "dk_ps_server_clock" in text
+
+
+def test_unreachable_server_typed_after_retries():
+    c = PSClient("127.0.0.1:1", attempts=2, backoff=0.0)
+    with pytest.raises(PSUnavailable):
+        c.pull()
+
+
+def test_fault_points_absorbed_or_typed(ps_server):
+    c = _client(ps_server, attempts=3)
+    j = c.join()
+    # a transient OSError injection is ABSORBED by the named surface
+    with faults.armed("ps.pull", exc=OSError):
+        assert c.pull(j["wid"])["version"] == 0
+    # a permanent FaultInjected surfaces typed (simulated kill)
+    with faults.armed("ps.commit"):
+        with pytest.raises(FaultInjected):
+            c.commit(j["wid"], 0, _delta(1))
+    with faults.armed("ps.join"):
+        with pytest.raises(FaultInjected):
+            c.join()
+    # the seam stays usable after the faults
+    assert c.commit(j["wid"], 0, _delta(1))["version"] == 1
+
+
+def test_malformed_addr_and_missing_addr_actionable(monkeypatch):
+    monkeypatch.delenv("DK_PS_ADDR", raising=False)
+    with pytest.raises(ValueError, match="DK_PS_ADDR"):
+        PSClient()
+    with pytest.raises(ValueError, match="host:port"):
+        PSClient("no-port-here")
+
+
+def test_reaper_host_drop_evidence(tmp_path, monkeypatch):
+    """The supervise_run liveness plane feeds the reaper: a worker
+    whose rank's heartbeat file went dark is lapsed with reason
+    host_drop — without waiting out the lease TTL."""
+    coord = tmp_path / "coord"
+    hb = coord / "hb"
+    hb.mkdir(parents=True)
+    (hb / "rank_1").write_text("beat")
+    old = time.time() - 3600
+    os.utime(hb / "rank_1", (old, old))
+    monkeypatch.setenv("DK_COORD_DIR", str(coord))
+    monkeypatch.setenv("DK_COORD_WORLD", "2")
+    srv = PSServer(params=_params(0), port=0, lease_s=3600.0)
+    try:
+        srv.center.join(wid="wdead", rank=1, now=0.0)
+        srv.center.join(wid="wlive", rank=0, now=0.0)
+        dead = srv._reap_once(now=1.0)
+        # the lapse names the convicted HOST: the lease's rank rides
+        # the attribution
+        assert ("wdead", 1, "host_drop") in dead
+        assert srv.center.stats()["workers"] == 1
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# worker mode end-to-end
+# ---------------------------------------------------------------------
+
+def _worker(srv, seed, **kw):
+    kw.setdefault("communication_window", 4)
+    kw.setdefault("worker_optimizer", "sgd")
+    kw.setdefault("optimizer_kwargs", {"learning_rate": 0.05})
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("num_epoch", 2)
+    kw.setdefault("label_col", "label_encoded")
+    return PSWorkerTrainer(
+        mnist_mlp(hidden=(16,), input_dim=8, num_classes=2, seed=0),
+        server_addr=f"{srv.address[0]}:{srv.address[1]}", seed=seed,
+        **kw)
+
+
+def _accuracy(model, ds):
+    from dist_keras_tpu.data import (AccuracyEvaluator,
+                                     LabelIndexTransformer,
+                                     ModelPredictor)
+
+    pred = ModelPredictor(model, features_col="features").predict(ds)
+    idx = LabelIndexTransformer(input_col="prediction").transform(pred)
+    return AccuracyEvaluator(prediction_col="prediction_index",
+                             label_col="label").evaluate(idx)
+
+
+def test_two_workers_learn_with_real_staleness(blobs_dataset, tmp_path):
+    srv = PSServer(params=mnist_mlp(hidden=(16,), input_dim=8,
+                                    num_classes=2, seed=0).params,
+                   port=0, window=4)
+    srv.start()
+    try:
+        trainers = [_worker(srv, seed=i) for i in range(2)]
+        models, errs = {}, []
+
+        def run(i):
+            try:
+                models[i] = trainers[i].train(blobs_dataset)
+            # the thread must record, not swallow: the assert below re-raises
+            except Exception as e:  # noqa: BLE001 - test harness
+                errs.append(e)
+
+        ths = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+        [t.start() for t in ths]
+        [t.join(timeout=300) for t in ths]
+        assert not errs, errs
+        assert len(models) == 2
+        for m in models.values():
+            assert _accuracy(m, blobs_dataset) > 0.9
+        st = srv.center.stats()
+        assert st["workers"] == 2
+        total_commits = sum(w["commits"]
+                            for w in st["per_worker"].values())
+        assert st["clock"] == total_commits
+        # concurrent workers MUST have produced nonzero staleness —
+        # otherwise this test degenerates to DOWNPOUR and proves
+        # nothing about the scaling path
+        assert any(s > 0 for t in trainers
+                   for _, s, _ in t.commit_log)
+        # the authoritative result is the CENTER: the server's final
+        # center must itself clear the bar (each worker's returned
+        # model is the center AS OF its own final pull — the first
+        # finisher may legitimately miss the other's last commits, so
+        # cross-model bit-equality is NOT a valid assertion here)
+        _, center = srv.center.state()
+        final = mnist_mlp(hidden=(16,), input_dim=8, num_classes=2,
+                          seed=0)
+        final.set_params(center)
+        assert _accuracy(final, blobs_dataset) > 0.9
+    finally:
+        srv.close()
+
+
+class _RivalClient(PSClient):
+    """Wraps the real client: before every odd commit of the worker, a
+    rival commits a zero delta first — deterministically making the
+    worker's version stale by exactly 1."""
+
+    def __init__(self, addr):
+        super().__init__(addr, attempts=2, backoff=0.01)
+        self._n = 0
+        self._rival = None
+
+    def commit(self, wid, version, delta, **kw):
+        self._n += 1
+        if self._n % 2 == 1:
+            if self._rival is None:
+                self._rival = super().join()["wid"]
+            fresh = super().pull(self._rival)
+            zero = jax.tree.map(np.zeros_like, delta)
+            super().commit(self._rival, fresh["version"], zero)
+        return super().commit(wid, version, delta, **kw)
+
+
+def test_worker_over_cap_re_pulls_and_completes(blobs_dataset):
+    """cap=0: every rival-interleaved commit is REFUSED typed; the
+    worker drops that window's delta, re-pulls, and still completes —
+    bounded damage, never a wedge."""
+    srv = PSServer(params=mnist_mlp(hidden=(16,), input_dim=8,
+                                    num_classes=2, seed=0).params,
+                   port=0, window=4, staleness_cap=0)
+    srv.start()
+    try:
+        t = _worker(srv, seed=0, num_epoch=1,
+                    client=_RivalClient(
+                        f"{srv.address[0]}:{srv.address[1]}"))
+        model = t.train(blobs_dataset)
+        assert t.stale_rejections > 0
+        # every APPLIED commit was fresh (cap 0 admits only staleness 0)
+        assert all(s == 0 for _, s, _ in t.commit_log)
+        assert model is not None
+    finally:
+        srv.close()
+
+
+def test_late_joiner_pulls_and_goes(blobs_dataset):
+    """A replacement worker joining an already-advanced run starts
+    from the CURRENT center (join doubles as the first pull)."""
+    srv = PSServer(params=mnist_mlp(hidden=(16,), input_dim=8,
+                                    num_classes=2, seed=0).params,
+                   port=0, window=4)
+    srv.start()
+    try:
+        _worker(srv, seed=0, num_epoch=1).train(blobs_dataset)
+        clock_before = srv.center.clock
+        assert clock_before > 0
+        late = _worker(srv, seed=1, num_epoch=1)
+        late.train(blobs_dataset)
+        joined_version = late.commit_log[0][0] - 1 if late.commit_log \
+            else None
+        assert joined_version is None or joined_version >= clock_before
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# observability + launch wiring
+# ---------------------------------------------------------------------
+
+def test_server_emits_ps_events_and_report_attributes(
+        tmp_path, monkeypatch):
+    from dist_keras_tpu.observability import events, report
+
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    events.reset()
+    try:
+        srv = PSServer(params=_params(0), port=0, lease_s=0.2)
+        srv.start()
+        try:
+            c = _client(srv)
+            j = c.join(rank=1)
+            c.commit(j["wid"], 0, _delta(1))
+            c2 = _client(srv)
+            j2 = c2.join()
+            c2.commit(j2["wid"], 0, _delta(2))  # staleness 1: scaled
+            # let the lease lapse and the reaper notice
+            deadline = time.time() + 10
+            while (srv.center.stats()["workers"] > 0
+                   and time.time() < deadline):
+                time.sleep(0.05)
+        finally:
+            srv.close()
+    finally:
+        events.reset()
+    evs = report.read_events(str(d))
+    kinds = {e["kind"] for e in evs}
+    assert {"ps_worker_join", "ps_commit", "ps_stale_scaled",
+            "ps_worker_lapse"} <= kinds
+    s = report.summarize(evs)
+    assert sum(s["ps"]["commits_by_worker"].values()) == 2
+    assert s["ps"]["staleness_hist"].get(1) == 1
+    assert len(s["ps"]["joins"]) == 2
+    assert {lp["wid"] for lp in s["ps"]["lapses"]} \
+        == {j["wid"] for j in s["ps"]["joins"]}
+    text = report.render(str(d))
+    assert "parameter server: commits by worker" in text
+    assert "worker lapse" in text
+
+
+def test_report_ps_attribution_from_synthetic_events():
+    from dist_keras_tpu.observability import report
+
+    evs = [
+        {"t": 1.0, "rank": 0, "kind": "ps_worker_join", "wid": "w0",
+         "worker_rank": 3, "rejoined": False},
+        {"t": 2.0, "rank": 0, "kind": "ps_commit", "wid": "w0",
+         "version": 1, "staleness": 0, "scale": 1.0},
+        {"t": 3.0, "rank": 0, "kind": "ps_commit", "wid": "w0",
+         "version": 2, "staleness": 2, "scale": 1 / 3},
+        {"t": 4.0, "rank": 0, "kind": "ps_stale_scaled", "wid": "w1",
+         "staleness": 9, "cap": 4, "rejected": True},
+        {"t": 5.0, "rank": 0, "kind": "ps_worker_lapse", "wid": "w0",
+         "reason": "lease"},
+    ]
+    s = report.summarize(evs)
+    assert s["ps"]["commits_by_worker"] == {"w0": 2}
+    assert s["ps"]["staleness_hist"] == {0: 1, 2: 1}
+    assert s["ps"]["rejected_stale"] == 1
+    assert s["ps"]["lapses"][0]["reason"] == "lease"
+
+
+def test_job_exports_dk_ps_env(tmp_path):
+    from dist_keras_tpu.launch.job import Job
+
+    j = Job("s", "j", str(tmp_path), hosts=["h0", "h1"],
+            ps_addr="10.0.0.9:7447", ps_window=16)
+    env = j.host_env(1)
+    assert env["DK_PS_ADDR"] == "10.0.0.9:7447"
+    assert env["DK_PS_WINDOW"] == "16"
+    with pytest.raises(ValueError, match="host:port"):
+        Job("s", "j", str(tmp_path), hosts=["h0"], ps_addr="nope")
+
+
+def test_job_config_ps_fields(tmp_path):
+    from dist_keras_tpu.launch.config import JobConfig
+
+    cfg = JobConfig.from_dict({
+        "secret": "s", "job_name": "j", "job_dir": str(tmp_path),
+        "hosts": ["h0"], "ps_addr": "1.2.3.4:5", "ps_window": 8})
+    job = cfg.to_job(dry_run=True)
+    assert job.host_env(0)["DK_PS_ADDR"] == "1.2.3.4:5"
+
+
+def test_ps_knobs_registered():
+    from dist_keras_tpu.utils import knobs
+
+    for name in ("DK_PS_ADDR", "DK_PS_PORT", "DK_PS_WINDOW",
+                 "DK_PS_LEASE_S", "DK_PS_STALENESS_CAP",
+                 "DK_PS_COMMIT_DEADLINE_S"):
+        assert name in knobs.KNOBS
+    assert knobs.get("DK_PS_WINDOW") == 32
+    assert knobs.get("DK_PS_STALENESS_CAP") == 1000
+
+
+def test_ps_error_taxonomy():
+    assert issubclass(StaleCommit, PSError)
+    assert issubclass(PSUnavailable, OSError)
+    assert issubclass(PSUnavailable, PSError)
