@@ -1004,6 +1004,75 @@ def bench_ckpt_async_save(peak=None, sizes=(64, 256), reps=3,
         timeout_s=timeout_s)
 
 
+# Differential-checkpoint row: chunk bytes written + save wall vs
+# churn fraction.  DK_CKPT_ASYNC=0 so the measured wall IS the write
+# (the async row already owns the stall story); DK_CKPT_DIFF=1 with
+# 4 MB chunks so churn granularity is 16/64 chunks at 64/256 MB.
+# CPU-pinned subprocess like every host-side row.  argv: mb... reps
+_DIFF_CKPT_WORKER = r"""
+import json, os, shutil, statistics, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DK_CKPT_ASYNC"] = "0"
+os.environ["DK_CKPT_DIFF"] = "1"
+os.environ["DK_CKPT_CHUNK_MB"] = "4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.checkpoint import Checkpointer
+
+sizes, reps = [int(a) for a in sys.argv[1:-1]], int(sys.argv[-1])
+CHURNS = (0.0, 0.25, 1.0)
+rows = []
+for mb in sizes:
+    n = mb * 1024 * 1024 // 8
+    work = tempfile.mkdtemp(prefix="dk_bench_diff_%d_" % mb)
+    ck = Checkpointer(work, max_to_keep=2)
+    w = np.asarray(np.random.default_rng(0).standard_normal(n))
+    t0 = time.perf_counter()
+    ck.save(1, {"w": w}).wait()
+    full_wall = time.perf_counter() - t0
+    full_bytes = ck.last_diff_stats["bytes_written"]
+    step = 1
+    for churn in CHURNS:
+        walls, written = [], []
+        for rep in range(reps):
+            step += 1
+            if churn:
+                # churn the FIRST fraction of elements: exactly
+                # ceil(churn * chunks) chunk identities change
+                w = w.copy()
+                w[: int(n * churn)] += 1.0
+            t0 = time.perf_counter()
+            ck.save(step, {"w": w}).wait()
+            walls.append(time.perf_counter() - t0)
+            written.append(ck.last_diff_stats["bytes_written"])
+        med = int(statistics.median(written))
+        rows.append({
+            "payload_mb": mb, "churn": churn,
+            "save_wall_s": round(statistics.median(walls), 4),
+            "full_save_wall_s": round(full_wall, 4),
+            "chunk_bytes_written": med,
+            "chunk_bytes_full": int(full_bytes),
+            "write_ratio": round(med / full_bytes, 4),
+        })
+    shutil.rmtree(work, ignore_errors=True)
+print(json.dumps({"reps": reps, "rows": rows}))
+"""
+
+
+def bench_diff_ckpt(peak=None, sizes=(64, 256), reps=3, timeout_s=360):
+    """Differential-checkpoint cost (``diff_ckpt``): chunk bytes
+    written and save wall vs churn fraction (0%/25%/100%) at 64/256 MB
+    payloads, median-of-``reps``.  The tentpole claim tracked every
+    round: a 25%-churn save writes < 40% of the full-save bytes (the
+    ISSUE 14 acceptance floor), and a 0%-churn save writes ~nothing.
+    No ``vs_baseline`` (the reference has no checkpointing at all)."""
+    return _run_cpu_worker(
+        "diff_ckpt", source=_DIFF_CKPT_WORKER,
+        args=(*sizes, reps), strip_prefixes=("DK_CKPT",),
+        timeout_s=timeout_s)
+
+
 def bench_ckpt_manifest(peak=None, mb=64, reps=5, timeout_s=300):
     """Integrity-manifest cost: ``Checkpointer.save`` with vs without
     ``DK_CKPT_VERIFY`` (median-of-``reps`` on a ``mb``-MB pytree) plus
@@ -1166,6 +1235,8 @@ def main():
                                    "ckpt_manifest_overhead"),
                                   (bench_ckpt_async_save,
                                    "ckpt_async_save"),
+                                  (bench_diff_ckpt,
+                                   "diff_ckpt"),
                                   (bench_retrace_proxy,
                                    "bench_retrace_proxy"),
                                   (bench_reshard_restore,
@@ -1198,9 +1269,9 @@ def main():
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
                bench_adag_streamed, bench_serving, bench_ckpt_manifest,
-               bench_ckpt_async_save, bench_retrace_proxy,
-               bench_reshard_restore, bench_transformer_tp,
-               bench_long_context):
+               bench_ckpt_async_save, bench_diff_ckpt,
+               bench_retrace_proxy, bench_reshard_restore,
+               bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:
             _OUT["configs"].append({"name": fn.__name__,

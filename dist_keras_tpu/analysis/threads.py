@@ -34,6 +34,11 @@ outside straight-line main-thread code".
 KNOWN_THREAD_ROOTS = {
     # async checkpoint pipeline (round 14)
     "ckpt.async_writer": "checkpoint.py:Checkpointer._writer_loop",
+    # remote checkpoint tier (round 18)
+    "ckpt.uploader": "resilience/store.py:CheckpointUploader._loop",
+    "ckpt.store_http": "resilience/store.py:ObjectStoreServer"
+                       ".serve_forever",
+    "ckpt.store_http_handler": "~resilience/store.py:_StoreHandler.*",
     # streaming data plane
     "stream.socket_server": "data/streaming.py:SocketSource._serve",
     # serving tier

@@ -122,6 +122,53 @@ it (the ``ckpt_manifest_overhead`` bench row).  Now, behind
   ``ckpt_async_save`` row reports.  Fault points: ``"ckpt.snapshot"``
   (caller thread, before the host snapshot) and ``"ckpt.write"``
   (mid-payload-write on the writer: staging torn, never promoted).
+
+This PR — DIFFERENTIAL saves + the REMOTE checkpoint tier.  The
+chunked writer already computes every chunk's SHA-256 as the bytes
+stream out; behind ``DK_CKPT_DIFF`` those hashes become chunk
+IDENTITIES:
+
+- **Content-addressed differential saves.**  Chunk bytes land ONCE in
+  a shared ``chunks/`` CAS directory beside the step dirs, named by
+  their SHA-256; the per-step ``chunks.json`` leaf tables and the
+  integrity manifest reference them by relative path
+  (``../chunks/<sha>``), so ``verify`` / ``restore`` /
+  ``reshard_restore`` read them through the existing machinery
+  unchanged.  A save SKIPS writing any chunk whose hash already sits
+  in the CAS — the previous promoted step's unchanged chunks, frozen
+  towers, adapter runs — paying only the in-memory hash (the
+  ``bench_diff_ckpt`` row: chunk bytes written vs churn fraction).
+  ``small.pkl`` / ``chunks.json`` / ``manifest.json`` stay per-step.
+  Requires hashing, so ``DK_CKPT_VERIFY=0`` disables the differential
+  path along with it (the plain in-payload chunk layout returns).
+- **Retention-aware crash-safe chunk GC** (:meth:`Checkpointer.
+  gc_chunks`, run by the writer after retention; leader-only on
+  pods).  A chunk is LIVE while ANY step-shaped directory references
+  it — retained steps, stranded ``.old`` copies, quarantined
+  ``.corrupt`` evidence, and in-flight ``.mh``/``.tmp`` staging — and
+  collection is additionally fenced by an mtime grace window
+  (``DK_CKPT_GC_GRACE_S``; skipped-chunk reuse touches the file), so
+  a peer host's save that referenced a chunk moments ago can never
+  race its deletion.  Deletions are journaled
+  (``chunks/gc-journal.json``, durable before the first unlink — the
+  ``"ckpt.gc"`` fault point fires exactly between) and the sweep
+  recomputes liveness from scratch every run: a kill at ANY instant
+  leaves every referenced chunk in place and the next sweep finishes
+  the job.  GC failures never fail the save (maintenance is
+  best-effort; the ``ckpt_gc`` event records either outcome).
+- **Remote tier** (``resilience/store.py``): with ``DK_CKPT_REMOTE``
+  set, a background uploader mirrors every promoted step to a
+  pluggable object store (CAS chunks dedup remotely by the same
+  content address; a ``COMPLETE`` marker written last is the remote
+  commit instant), and ``restore`` / ``reshard_restore`` / the
+  serving ``CheckpointWatcher`` FALL BACK to it: a missing local step
+  (the spot-fleet replacement host with a fresh disk) fetches from
+  the store and reshards onto the new world; a convicted-corrupt
+  local step is quarantined and re-fetched clean.  Fetches stage
+  locally and promote through the same journaled swap, then pass the
+  same manifest verification as any local restore — remote bytes are
+  never trusted blind.  Fault points ``"ckpt.push"`` / ``"ckpt.pull"``
+  fire inside the named retry surfaces.
 """
 
 from __future__ import annotations
@@ -271,6 +318,18 @@ def _chunk_bytes():
     legacy orbax/pickle writer.  Readers understand BOTH formats
     regardless of this knob."""
     return int(max(0.0, float(knobs.get("DK_CKPT_CHUNK_MB"))) * 2**20)
+
+
+def _diff_enabled():
+    """``DK_CKPT_DIFF`` (default off — opt-in this round): chunked
+    saves become content-addressed DIFFERENTIAL saves against the
+    shared ``chunks/`` CAS directory.  Requires hashing, so
+    ``DK_CKPT_VERIFY=0`` disables it regardless."""
+    return knobs.get("DK_CKPT_DIFF")
+
+
+CAS_DIR_NAME = "chunks"
+GC_JOURNAL_NAME = "gc-journal.json"
 
 
 def _snapshot_host(tree):
@@ -510,7 +569,7 @@ class Checkpointer:
 
     def __init__(self, directory, max_to_keep=3, fsync=True, retry=None,
                  rank=None, world=None, commit_timeout_s=None,
-                 commit_poll_s=0.02):
+                 commit_poll_s=0.02, diff=None, remote_store=None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = int(max_to_keep)
@@ -546,6 +605,17 @@ class Checkpointer:
         self._async_active = None   # handle currently being written
         self._async_thread = None
         self._async_error = None
+        # differential/remote tier: ``diff=None`` resolves DK_CKPT_DIFF
+        # per save; ``remote_store=None`` resolves DK_CKPT_REMOTE per
+        # call (launcher-export contract).  The uploader is armed
+        # lazily by save() when a remote is configured.
+        self._diff = diff
+        self._remote_store = remote_store
+        self._uploader = None
+        # chunk-level stats of the LAST chunked payload this instance
+        # wrote (None before any, or for non-differential saves) —
+        # introspection for the bench row and tests
+        self.last_diff_stats = None
 
     def _step_dir(self, step):
         return os.path.join(self.directory, f"step_{step:08d}")
@@ -706,6 +776,15 @@ class Checkpointer:
             full = os.path.join(self.directory, name)
             if not name.startswith("step_") or _STEP_RE.match(name):
                 continue
+            # only STEP-SHAPED names are ever staging: a `step_<N>`
+            # stem plus a suffix (.tmp/.mh/.old/.corrupt/.fetch/orbax
+            # leftovers).  Anything else that happens to start with
+            # "step_" — an operator's notes, a tool's scratch file —
+            # is not ours to delete (the `chunks/` CAS dir and the GC
+            # journal don't start with "step_" at all and are skipped
+            # by the guard above).
+            if not _STEP_RE.match(name.split(".", 1)[0]):
+                continue
             if self._inflight and name.startswith(self._inflight):
                 continue
             if name.endswith(".old") and _STEP_RE.match(name[:-4]):
@@ -809,6 +888,7 @@ class Checkpointer:
         fault_point("ckpt.snapshot")
         step = int(step)
         rank, world = self._coord_ids()
+        self._maybe_start_uploader(rank, world)
         if not use_async:
             state = _to_host(state)
             # drain any in-flight async write first (the knob re-reads
@@ -946,6 +1026,7 @@ class Checkpointer:
             # dklint: ignore[unguarded-shared-write] same single-writer argument as the store above
             self._inflight = None
         self._retain()
+        self.gc_chunks()
         dt = _time.perf_counter() - t0
         metrics.histogram("ckpt.write_s").observe(dt)
         events.emit("ckpt_save", step=step, world=world, duration_s=dt)
@@ -1152,6 +1233,18 @@ class Checkpointer:
         # integrity cost", and hashing multi-GB chunks to discard the
         # digests would silently keep charging it
         hashing = _verify_enabled()
+        # the differential path NEEDS the hashes (they are the chunk
+        # identities), so opting out of hashing opts out of diff too
+        diff_on = hashing and (self._diff if self._diff is not None
+                               else _diff_enabled())
+        cas_dir = os.path.join(self.directory, CAS_DIR_NAME)
+        # the CAS reference recorded in chunks.json/manifest is
+        # RELATIVE to the payload dir; tmp and its final location sit
+        # at the same depth under the checkpoint directory, so the
+        # path computed against staging stays valid after the promote
+        cas_rel = os.path.relpath(cas_dir, tmp)
+        stats = {"chunks": 0, "skipped": 0,
+                 "bytes_written": 0, "bytes_skipped": 0}
 
         def _put(rel, blocks):
             h = hashlib.sha256() if hashing else None
@@ -1164,6 +1257,60 @@ class Checkpointer:
                     n += len(block)
             if h is not None:
                 entries[rel] = {"bytes": n, "sha256": h.hexdigest()}
+
+        def _put_chunk(i, k, block):
+            """One chunk of one leaf; -> the rel path its leaf table
+            records.  Differential mode: the chunk's SHA-256 is its
+            identity — a hash already in the CAS is REFERENCED (the
+            byte write skipped, the file touched so the GC grace
+            window covers the reuse), a new one lands atomically
+            (tmp + rename: two hosts racing the same content commit
+            identical bytes either order)."""
+            if not diff_on:
+                rel = f"chunk_{i:04d}.{k:05d}"
+                _put(rel, (block,))
+                return rel
+            h = hashlib.sha256()
+            h.update(block)
+            sha = h.hexdigest()
+            n = len(block)
+            rel = os.path.join(cas_rel, sha)
+            entries[rel] = {"bytes": n, "sha256": sha}
+            stats["chunks"] += 1
+            full = os.path.join(cas_dir, sha)
+            if os.path.exists(full):
+                # reuse trusts the content address by name + SIZE: a
+                # truncated entry falls through and is rewritten in
+                # place (os.replace heals it for every referencing
+                # step), while same-size bit rot inside a reused chunk
+                # is convicted by the very next verify/restore through
+                # the manifest — loud, never silent — and healed from
+                # the remote tier, whose fetch re-hashes local CAS
+                # entries before trusting them.  Re-hashing here would
+                # charge a full read per skipped chunk and erase the
+                # differential win.
+                try:
+                    if os.path.getsize(full) != n:
+                        raise OSError(
+                            f"CAS entry {sha} truncated: rewrite")
+                    os.utime(full, None)  # reuse: reset the GC grace
+                    stats["skipped"] += 1
+                    stats["bytes_skipped"] += n
+                    return rel
+                except OSError:
+                    pass  # truncated, or deleted by a raced GC sweep
+                    #       between exists and touch: write it fresh
+            os.makedirs(cas_dir, exist_ok=True)
+            ctmp = os.path.join(cas_dir,
+                                f".tmp-{os.getpid()}-{sha[:16]}")
+            with open(ctmp, "wb") as f:
+                f.write(block)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(ctmp, full)
+            stats["bytes_written"] += n
+            return rel
 
         flat, treedef = jax.tree_util.tree_flatten(state)
         skeleton, leaf_meta = [], []
@@ -1182,9 +1329,8 @@ class Checkpointer:
             mv = arr.reshape(-1).view(np.uint8)
             files = []
             for k in range((arr.nbytes + chunk_bytes - 1) // chunk_bytes):
-                rel = f"chunk_{i:04d}.{k:05d}"
-                _put(rel, (mv[k * chunk_bytes:(k + 1) * chunk_bytes],))
-                files.append(rel)
+                files.append(_put_chunk(
+                    i, k, mv[k * chunk_bytes:(k + 1) * chunk_bytes]))
                 if not fired:
                     fired = True  # mid-stream: some chunks staged only
                     fault_point("ckpt.write")
@@ -1217,6 +1363,18 @@ class Checkpointer:
             write_manifest(tmp, entries=entries)
         if self.fsync:
             _fsync_tree(tmp)
+        if diff_on:
+            from dist_keras_tpu.observability import events, metrics
+
+            metrics.counter("ckpt.chunks_skipped").inc(stats["skipped"])
+            events.emit("ckpt_diff", chunks=stats["chunks"],
+                        skipped=stats["skipped"],
+                        bytes_written=stats["bytes_written"],
+                        bytes_skipped=stats["bytes_skipped"])
+        # single writer at a time (the sync path drains the async queue
+        # first, and the writer thread is the only other author), so
+        # the reference assignment is safe — same argument as _inflight
+        self.last_diff_stats = dict(stats) if diff_on else None
 
     def _swap_in(self, src, final):
         """Journaled overwrite swap: the committed version is RETIRED to
@@ -1369,6 +1527,7 @@ class Checkpointer:
             self._inflight = None
         if rank == 0:
             self._retain()
+            self.gc_chunks()
 
     # -- integrity: verify / quarantine / verified fallback -------------
     def verify(self, step=None, all_hosts=False):
@@ -1466,6 +1625,157 @@ class Checkpointer:
             _fsync_dir(self.directory)
         return True
 
+    # -- remote checkpoint tier ------------------------------------------
+    def _remote(self):
+        """The configured remote store, or None: the constructor's
+        ``remote_store`` wins, else ``DK_CKPT_REMOTE`` is re-read per
+        call (launcher-exported values win regardless of construction
+        order)."""
+        if self._remote_store is not None:
+            return self._remote_store
+        from dist_keras_tpu.resilience import store as _store
+
+        return _store.store_from_env()
+
+    def has_remote(self):
+        return self._remote() is not None
+
+    def remote_steps(self):
+        """Steps the remote tier holds a COMPLETE marker for (sorted).
+        Raises the store's typed error on an unreachable tier."""
+        s = self._remote()
+        if s is None:
+            return []
+        from dist_keras_tpu.resilience import store as _store
+
+        return _store.remote_steps(s)
+
+    def _remote_has_quiet(self, step):
+        """True when the remote tier completely holds ``step`` — a
+        PROBE: an unreachable/broken store reads as "no" (the callers
+        are fallback paths that must degrade, not die, when the remote
+        tier is the thing that is down)."""
+        s = self._remote()
+        if s is None:
+            return False
+        from dist_keras_tpu.resilience import store as _store
+
+        try:
+            return _store.remote_has_step(s, step)
+        except OSError:
+            return False
+
+    def _fetch_allowed(self, rank, world):
+        """A fetch WRITES the local directory, so it follows the
+        writer-side discipline: leader-only on shared-dir pods."""
+        return not (world > 1 and rank != 0 and _two_phase_enabled())
+
+    def fetch_remote(self, step=None):
+        """Pull ``step`` (default: the newest remote COMPLETE step)
+        from the remote tier into the local directory and promote it
+        with the normal journaled swap; -> the step.  The fetched copy
+        then restores/verifies exactly like a locally written one —
+        remote bytes are never trusted blind.  On a shared-dir pod a
+        non-leader rank WAITS (bounded) for the leader's fetch to
+        appear instead of racing it.  ``FileNotFoundError`` when no
+        remote tier is configured or it has no such step."""
+        from dist_keras_tpu.resilience.coordination import (
+            default_timeout_s,
+        )
+
+        s = self._remote()
+        if s is None:
+            raise FileNotFoundError(
+                "no remote checkpoint store configured "
+                "(DK_CKPT_REMOTE unset and no remote_store passed)")
+        from dist_keras_tpu.resilience import store as _store
+
+        rank, world = self._coord_ids()
+        if not self._fetch_allowed(rank, world):
+            after = None if step is None else int(step) - 1
+            got = self.wait_for_step_after(
+                after, timeout_s=default_timeout_s())
+            if got is None:
+                raise FileNotFoundError(
+                    "remote checkpoint fetch is leader-only on a "
+                    "shared checkpoint directory and the leader's "
+                    "fetched step never appeared within the deadline")
+            return got if step is None else int(step)
+        if step is None:
+            steps = _store.remote_steps(s)
+            if not steps:
+                raise FileNotFoundError(
+                    "remote checkpoint store holds no completed steps")
+            step = steps[-1]
+        step = int(step)
+        stage = _store.fetch_step(s, self.directory, step,
+                                  fsync=self.fsync)
+        self._swap_in(stage, self._step_dir(step))
+        return step
+
+    def fetch_remote_newer(self, after=None, skip=()):
+        """Fetch the NEWEST remote step strictly newer than ``after``
+        that is neither locally promoted already nor in ``skip``; ->
+        the step, or None when the remote tier has nothing newer (or
+        none is configured).  The serving watcher's pull-through seam."""
+        if self._remote() is None:
+            return None
+        have = set(self.all_steps())
+        for step in reversed(self.remote_steps()):
+            if after is not None and step <= after:
+                break
+            if step in have or step in skip:
+                continue
+            return self.fetch_remote(step)
+        return None
+
+    def _maybe_start_uploader(self, rank, world):
+        """Arm the background remote mirror once per instance when a
+        remote tier is configured and ``DK_CKPT_REMOTE_PUSH`` is on.
+        Leader-only on shared-dir pods (one mirror per pod — the
+        promoted step dir carries every host's payload).  Failures to
+        arm are absorbed: the run keeps its local durability."""
+        if self._uploader is not None:
+            return
+        if not knobs.get("DK_CKPT_REMOTE_PUSH"):
+            return
+        if not self._fetch_allowed(rank, world):
+            return
+        store = self._remote_store
+        if store is None \
+                and not (knobs.raw("DK_CKPT_REMOTE") or "").strip():
+            return
+        try:
+            from dist_keras_tpu.resilience.store import (
+                CheckpointUploader,
+            )
+
+            # single writer: save() is the only author of _uploader
+            # (the training/caller thread), reference assignment atomic
+            self._uploader = CheckpointUploader(
+                self, store=store).start()
+        # dklint: ignore[broad-except] a misconfigured remote must not
+        # kill the save that tripped the arming — local durability
+        # stands, the event names the reason
+        except Exception as e:
+            from dist_keras_tpu.observability import events
+
+            events.emit("ckpt_push", error=type(e).__name__,
+                        detail="uploader failed to start: "
+                               + str(e)[:160])
+            self._uploader = False  # don't retry every save
+
+    def stop_uploader(self, timeout_s=5.0, drain=False):
+        """Stop the background mirror (if one was armed); with
+        ``drain`` push anything still outstanding after the loop has
+        stopped (single poll driver at a time — the uploader's
+        contract)."""
+        u, self._uploader = self._uploader, None
+        if u:
+            u.stop(timeout_s)
+            if drain:
+                u.drain()
+
     def restore(self, step=None, template=None, verify=None,
                 elastic=None):
         """Restore ``step`` (default: latest). ``template``: a pytree with
@@ -1488,8 +1798,20 @@ class Checkpointer:
         for this (rank, world).  With it off, the pre-elastic
         semantics return."""
         check = _verify_enabled() if verify is None else bool(verify)
+        remote_tried = set()  # steps already re-fetched once: a remote
+        #                       copy that ALSO rots must not loop
         if step is None:
             step = self.latest_step()
+            if step is None and self.has_remote():
+                # the spot-fleet replacement host: nothing local, a
+                # remote tier configured — pull the newest completed
+                # step down and restore it like any local one (a
+                # world-N step then reshards below)
+                try:
+                    step = self.fetch_remote()
+                    remote_tried.add(step)
+                except FileNotFoundError:
+                    step = None  # empty store: same verdict as no dir
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         step = int(step)
@@ -1522,6 +1844,19 @@ class Checkpointer:
                     # ranks choosing different steps would diverge.
                     if world > 1 or not check:
                         raise
+                    if step not in remote_tried \
+                            and self._remote_has_quiet(step):
+                        # the remote tier still holds a clean copy of
+                        # exactly this step: re-fetch it over the
+                        # rotted local bytes and retry (the swap
+                        # retires the bad copy; the re-verify below
+                        # convicts the remote copy too if it rotted)
+                        remote_tried.add(step)
+                        try:
+                            self.fetch_remote(step)
+                            continue
+                        except (OSError, CheckpointCorrupt):
+                            pass  # remote unusable too: fall back
                     fallback = [s for s in self.all_steps()
                                 if s < step]
                     if not fallback:
@@ -1557,6 +1892,19 @@ class Checkpointer:
                                 "per-rank (peers would diverge); "
                                 "restart the pod from an earlier step"])
                     self._quarantine(step)
+                    if step not in remote_tried \
+                            and self._remote_has_quiet(step):
+                        # the remote mirror still holds this exact
+                        # step: pull the clean copy into the name the
+                        # quarantine just freed and retry — one
+                        # checkpoint cadence of staleness becomes
+                        # ZERO when the tier has the cure
+                        remote_tried.add(step)
+                        try:
+                            self.fetch_remote(step)
+                            continue
+                        except (OSError, CheckpointCorrupt):
+                            pass  # remote copy unusable: fall back
                     fallback = [s for s in self.all_steps() if s < step]
                     if not fallback:
                         raise
@@ -1716,3 +2064,144 @@ class Checkpointer:
                         and int(name[:-8].split("_")[1]) < horizon:
                     shutil.rmtree(os.path.join(self.directory, name),
                                   ignore_errors=True)
+
+    # -- content-addressed chunk GC --------------------------------------
+    def _live_chunks(self):
+        """Every CAS sha referenced by ANY step-shaped directory entry
+        — committed steps, retired ``.old`` copies, quarantined
+        ``.corrupt`` evidence, fetch staging, and in-flight
+        ``.mh``/``.tmp`` staging: a reference ANYWHERE pins the chunk.
+        Torn/unreadable ``chunks.json`` tables pin nothing themselves
+        (a mid-write table's chunks are inside the mtime grace window;
+        a promoted step's table is complete by construction)."""
+        from dist_keras_tpu.resilience.store import collect_cas_refs
+
+        live = set()
+        for name in os.listdir(self.directory):
+            if not _STEP_RE.match(name.split(".", 1)[0]):
+                continue  # chunks/ CAS, journal, operator files
+            root = os.path.join(self.directory, name)
+            if os.path.isdir(root):
+                live |= collect_cas_refs(root)
+        return live
+
+    def gc_chunks(self, raise_errors=False):
+        """Collect CAS chunks nothing references any more; -> how many
+        were removed.  Retention-aware by construction — it runs AFTER
+        :meth:`_retain`, and a chunk shared with any still-retained,
+        quarantined or in-flight step stays (see :meth:`_live_chunks`).
+        Leader-only on pods, like every other writer-side sweep.
+
+        Crash-safe: candidates younger (mtime) than
+        ``DK_CKPT_GC_GRACE_S`` are never touched (an in-flight save's
+        just-written or just-reused chunks), the doomed list is
+        journaled durably BEFORE the first unlink
+        (``chunks/gc-journal.json`` — the ``"ckpt.gc"`` fault point
+        fires exactly between journal and deletes), and liveness is
+        recomputed from scratch every sweep, so a kill at any instant
+        leaves every referenced chunk in place.  The next sweep
+        CONSUMES a crashed sweep's journal: its entries — already
+        verified unreferenced and aged when the intent was recorded —
+        finish collection immediately (grace-exempt, provided their
+        mtime is still older than the journal: a later touch means a
+        save adopted the chunk and the normal rules apply) instead of
+        re-waiting a full grace window per crash; liveness is still
+        re-checked.  GC is maintenance: failures are absorbed
+        (recorded on the ``ckpt_gc`` event) unless ``raise_errors``."""
+        import time as _time
+
+        from dist_keras_tpu.observability import events
+
+        rank, world = self._coord_ids()
+        if world > 1 and rank != 0 and _two_phase_enabled():
+            return 0
+        cas = os.path.join(self.directory, CAS_DIR_NAME)
+        if not os.path.isdir(cas):
+            return 0
+        journal = os.path.join(cas, GC_JOURNAL_NAME)
+        try:
+            from dist_keras_tpu.resilience.faults import fault_point
+
+            live = self._live_chunks()
+            grace = float(knobs.get("DK_CKPT_GC_GRACE_S"))
+            now = _time.time()
+            # consume a crashed sweep's journal: its entries were
+            # verified unreferenced AND past grace when the intent was
+            # made durable, so any of them still UNTOUCHED since then
+            # (mtime <= the journal's own timestamp — a later touch
+            # means some save adopted the chunk and the normal
+            # grace/liveness rules own it again) finish collection
+            # NOW instead of waiting out a fresh grace window after
+            # every crash.  Liveness is still re-checked below.
+            j_doomed, j_t = set(), None
+            try:
+                with open(journal) as f:
+                    j = json.load(f)
+                j_doomed = {str(x) for x in j["doomed"]}
+                j_t = float(j["t"])
+            except (OSError, ValueError, KeyError, TypeError):
+                pass  # no journal, or a torn one: plain sweep
+            resumed = 0
+            doomed = []
+            for name in os.listdir(cas):
+                if name == GC_JOURNAL_NAME or name in live:
+                    continue
+                full = os.path.join(cas, name)
+                try:
+                    mt = os.path.getmtime(full)
+                except OSError:  # pragma: no cover - raced delete
+                    continue
+                if now - mt < grace:
+                    if not (name in j_doomed and j_t is not None
+                            and mt <= j_t):
+                        continue  # maybe referenced by an in-flight
+                        #           save whose table isn't on disk yet
+                    resumed += 1
+                doomed.append(name)
+            if not doomed:
+                # a leftover journal from a crashed sweep: this sweep
+                # recomputed everything and found nothing to do — the
+                # record has served its purpose
+                try:
+                    os.remove(journal)
+                except OSError:
+                    pass
+                return 0
+            jtmp = journal + ".tmp"
+            with open(jtmp, "w") as f:
+                json.dump({"t": now, "doomed": doomed}, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(jtmp, journal)
+            if self.fsync:
+                _fsync_dir(cas)
+            # the deterministic mid-GC kill: intent durable, nothing
+            # deleted yet — every retained step must stay restorable
+            fault_point("ckpt.gc")
+            removed = 0
+            for name in doomed:
+                try:
+                    os.remove(os.path.join(cas, name))
+                    removed += 1
+                except OSError:  # pragma: no cover - raced
+                    pass
+            try:
+                os.remove(journal)
+            except OSError:  # pragma: no cover
+                pass
+            if self.fsync:
+                _fsync_dir(cas)
+            events.emit("ckpt_gc", collected=removed, live=len(live),
+                        grace_s=grace, resumed=resumed)
+            return removed
+        # dklint: ignore[broad-except] GC is maintenance — a failing
+        # sweep (or an injected chaos kill inside it) must not fail the
+        # save that triggered it; the event records it and the next
+        # sweep retries from scratch
+        except Exception as e:
+            if raise_errors:
+                raise
+            events.emit("ckpt_gc", error=type(e).__name__,
+                        detail=str(e)[:200])
+            return 0

@@ -93,6 +93,9 @@ KNOWN_EVENTS = (
     "ckpt_save", "ckpt_promote", "ckpt_restore", "ckpt_verify",
     "ckpt_corrupt",
     "ckpt_async_enqueue", "ckpt_async_coalesced", "ckpt_async_error",
+    # differential + remote checkpoint tier (checkpoint.py,
+    # resilience/store.py)
+    "ckpt_diff", "ckpt_gc", "ckpt_push", "ckpt_pull",
     # resilience seams
     "retry", "retry_exhausted", "fault", "nonfinite", "nan_halt",
     "preempt_signal", "preempt", "preempt_exit",

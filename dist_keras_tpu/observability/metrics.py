@@ -164,6 +164,10 @@ KNOWN_METRICS = {
     # waited vs what the (possibly background) writer spent
     "ckpt.save_stall_s": "histogram",
     "ckpt.write_s": "histogram",
+    # differential saves + remote tier (checkpoint.py,
+    # resilience/store.py)
+    "ckpt.chunks_skipped": "counter",
+    "ckpt.bytes_pushed": "counter",
     # streaming data plane
     "stream.batches": "counter",
     "stream.rows": "counter",

@@ -291,8 +291,16 @@ class PSServer(ThreadingHTTPServer):
             from dist_keras_tpu.checkpoint import Checkpointer
 
             # rank/world pinned: the PS is ONE process regardless of
-            # what DK_COORD_* the launcher exported for the workers
-            self._ckptr = Checkpointer(ckpt_dir, rank=0, world=1)
+            # what DK_COORD_* the launcher exported for the workers.
+            # diff=True routes the center's periodic saves through the
+            # content-addressed DIFFERENTIAL path (round 18): the
+            # center churns but its frozen leaves (integer RNG state,
+            # frozen towers) hash identical save over save, so each
+            # cadence rewrites only what moved — inert until leaves
+            # cross DK_CKPT_CHUNK_MB, and DK_CKPT_VERIFY=0 disables
+            # it with the hashing it needs.
+            self._ckptr = Checkpointer(ckpt_dir, rank=0, world=1,
+                                       diff=True)
         clock = 0
         restored_step = None
         if self._ckptr is not None:

@@ -51,6 +51,15 @@ Fault points: ``"reshard.load"`` fires per source payload read and
 is deterministically testable (both are in ``faults.KNOWN_POINTS`` for
 chaos mode).
 
+Remote tier (round 18): when the LOCAL directory holds no step at all
+— the replacement host of a spot fleet whose dead machines shared no
+disk with it — :func:`reshard_restore` pulls the newest completed step
+from the ``DK_CKPT_REMOTE`` store (``resilience/store.py``; fetched
+into local staging, promoted with the journaled swap, verified through
+the same manifests) and reshards that.  True spot-fleet elasticity:
+``gates.py --diff-ckpt-only`` proves a wiped-disk world-1 host
+restores a world-2 checkpoint purely from the remote tier.
+
 Chunked payloads (``DK_CKPT_CHUNK_MB``, the async-pipeline streaming
 format) reshard like any other: the pre-gather verification walks the
 manifest's per-chunk entries (one SHA-256 per ``chunk_NNNN.KKKKK``
@@ -281,6 +290,16 @@ def reshard_restore(checkpointer, step=None, template=None, verify=None,
     t0 = time.perf_counter()
     if step is None:
         step = checkpointer.latest_step()
+        if step is None and checkpointer.has_remote():
+            # the spot-fleet replacement host: no local step at all —
+            # pull the newest completed step from the remote tier
+            # (promoted locally through the normal journaled swap)
+            # and reshard THAT.  An empty store keeps the typed
+            # no-checkpoints verdict below.
+            try:
+                step = checkpointer.fetch_remote()
+            except FileNotFoundError:
+                step = None
     if step is None:
         raise FileNotFoundError(
             f"no checkpoints in {checkpointer.directory}")

@@ -36,6 +36,14 @@ recoverable I/O seam in the framework passes through a named
   one replica batch dispatch (the error lands TYPED on every future in
   the batch — never a hang), and one hot-reload attempt (the engine
   keeps serving the old params).
+- ``"ckpt.gc"`` — between the chunk GC's durable deletion journal and
+  its first unlink (``checkpoint.Checkpointer.gc_chunks``): raising
+  here IS the mid-GC kill, and every retained step must stay
+  restorable through it.
+- ``"ckpt.push"`` / ``"ckpt.pull"`` — per object transfer of the
+  remote checkpoint tier (``resilience/store.py``), inside the named
+  retry surfaces, so chaos exercises both the absorbed-transient and
+  the typed-kill path of the mirror protocol.
 
 Faults are scheduled on the point's CALL COUNT (0-based), so a test kills
 exactly the Nth save or fails exactly the first two rsyncs — no timing, no
@@ -112,6 +120,7 @@ _env_loaded = False
 KNOWN_POINTS = (
     "checkpoint.save", "checkpoint.commit", "coord.commit",
     "ckpt.snapshot", "ckpt.write",
+    "ckpt.gc", "ckpt.push", "ckpt.pull",
     "coord.flag", "coord.agree", "coord.barrier",
     "job.rsync", "job.ssh", "job.heartbeat",
     "punchcard.read_manifest", "stream.fetch", "step.loss",

@@ -41,6 +41,15 @@ Failure semantics (the serving third of the resilience story):
   restore re-partitions through ``resilience.elastic.reshard_restore``
   (sharded leaves gathered by global index, replicated leaves from
   the leader) instead of failing the per-rank payload lookup.
+- Remote tier (round 18): with ``DK_CKPT_REMOTE`` configured on the
+  serving host, the watcher becomes a PULL-THROUGH cache — each poll
+  first fetches any newly completed remote step missing locally
+  (``Checkpointer.fetch_remote_newer``; the spot-serving host whose
+  disk shares nothing with the trainer's), and a candidate convicted
+  corrupt is re-fetched clean from the store ONCE before being
+  skipped.  Both paths assume the watcher's checkpoint directory is
+  this host's own cache dir, which is exactly the deployment that
+  configures a remote tier.
 - Async/chunked saves (``DK_CKPT_ASYNC`` / ``DK_CKPT_CHUNK_MB`` on the
   TRAINER side) need nothing special here: the watcher still only ever
   sees PROMOTED steps (async staging is invisible until the same
@@ -100,6 +109,10 @@ class CheckpointWatcher:
         # this set each such poll would re-hash the corrupt steps'
         # whole payloads and re-emit reload_skipped_corrupt for them)
         self._corrupt_seen = set()
+        # steps whose rotted local copy was already re-fetched once
+        # from the remote tier — a remote copy that convicts too must
+        # not re-download every poll
+        self._remote_healed = set()
         self._stop = threading.Event()
         self._thread = None
 
@@ -118,6 +131,22 @@ class CheckpointWatcher:
         caller — the background loop is the path that absorbs it."""
         from dist_keras_tpu.checkpoint import CheckpointCorrupt
 
+        # remote tier first: a serving host whose checkpointer points
+        # at its OWN local cache dir (the spot-serving deployment that
+        # configures DK_CKPT_REMOTE) pulls newly completed remote
+        # steps down before the local scan — the pull-through half of
+        # the remote fallback.  Typed pull failures are absorbed (the
+        # ckpt.pull retry surface already recorded them); the engine
+        # keeps serving whatever it has.
+        if self.checkpointer.has_remote():
+            try:
+                self.checkpointer.fetch_remote_newer(
+                    self.last_step, skip=self._corrupt_seen)
+            except (OSError, CheckpointCorrupt) as e:
+                metrics.counter("serve.reload.errors").inc()
+                events.emit("serve_reload_error",
+                            error=type(e).__name__,
+                            detail="remote fetch: " + str(e)[:160])
         # timeout_s=0 = a single non-blocking probe of the promoted
         # steps; the BLOCKING wait stays in wait_for_step_after for
         # direct callers, while this loop keeps its own stoppable
@@ -156,6 +185,26 @@ class CheckpointWatcher:
                 step = cand
                 break
             except CheckpointCorrupt as e:
+                if cand not in self._remote_healed \
+                        and self.checkpointer._remote_has_quiet(cand):
+                    # the remote tier still holds this exact step:
+                    # replace the rotted local copy with the clean
+                    # remote bytes and re-verify ONCE — the serving
+                    # analogue of restore()'s remote self-heal.
+                    # (Assumes the watcher's directory is this host's
+                    # own pull-through cache — the deployment that
+                    # configures a remote tier.)
+                    self._remote_healed.add(cand)
+                    try:
+                        self.checkpointer.fetch_remote(cand)
+                        _r, world = self.checkpointer._coord_ids()
+                        self.checkpointer.verify(
+                            cand, all_hosts=self.checkpointer
+                            .saved_world(cand) != world)
+                        step = cand
+                        break
+                    except (OSError, CheckpointCorrupt):
+                        pass  # remote copy unusable too: convict
                 self._corrupt_seen.add(cand)
                 self.skipped_corrupt += 1
                 metrics.counter("serve.reload.skipped_corrupt").inc()
@@ -192,9 +241,11 @@ class CheckpointWatcher:
     def _advance(self, step):
         self.last_step = step
         # convictions at or below the new horizon are subsumed by
-        # last_step; the set only ever holds the (bounded) window of
+        # last_step; the sets only ever hold the (bounded) window of
         # corrupt steps newer than an intact one still being retried
         self._corrupt_seen = {s for s in self._corrupt_seen if s > step}
+        self._remote_healed = {s for s in self._remote_healed
+                               if s > step}
 
     def _loop(self):
         while not self._stop.is_set():

@@ -111,6 +111,30 @@ _register("DK_CKPT_CHUNK_MB", 64.0, float, kind="MB",
               "SHA-256 is computed as the bytes stream out (one "
               "pass); `0` falls back to the legacy un-chunked "
               "orbax/pickle payload format")
+_register("DK_CKPT_DIFF", False, _parse_bool, kind="bool",
+          doc="`1` makes chunked saves DIFFERENTIAL: chunk bytes land "
+              "once in the shared `chunks/` content-addressed store "
+              "(named by their SHA-256) and a save skips any chunk "
+              "whose hash is already there — unchanged leaves cost "
+              "only the in-memory hash.  Needs hashing, so "
+              "`DK_CKPT_VERIFY=0` disables it")
+_register("DK_CKPT_GC_GRACE_S", 120.0, float, kind="seconds",
+          doc="chunk GC never collects a CAS entry whose mtime is "
+              "younger than this — the fence protecting an in-flight "
+              "save's just-written or just-reused chunks (reuse "
+              "touches the file)")
+_register("DK_CKPT_REMOTE", None, str,
+          "remote checkpoint store URL (`http://host:port[/prefix]`, "
+          "`file:///dir` or a plain directory): promoted steps mirror "
+          "out through the background uploader and "
+          "restore/reshard/the serving watcher fall back to it when "
+          "the local step is missing or convicted corrupt")
+_register("DK_CKPT_REMOTE_PUSH", True, _parse_bool, kind="bool",
+          doc="`0` keeps the remote tier READ-ONLY for this process: "
+              "no background uploader is armed, restores still pull")
+_register("DK_CKPT_REMOTE_POLL_S", 2.0, float, kind="seconds",
+          doc="background uploader poll cadence for newly promoted "
+              "steps")
 
 # elastic world resize
 _register("DK_ELASTIC", True, _parse_bool, kind="bool",

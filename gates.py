@@ -97,10 +97,25 @@ the ``ps.pull`` / ``ps.commit`` / ``ps.join`` fault points rides
 along: every run ends completed or typed with a verified promoted
 center-variable step — never a hang.
 
+The DIFF-CKPT gate (``--diff-ckpt-only``, round 18) is the
+differential + remote checkpoint acceptance: K seeded chaos runs
+restricted to the ``checkpoint.save`` / ``checkpoint.commit`` /
+``ckpt.write`` / ``ckpt.gc`` / ``ckpt.push`` / ``ckpt.pull`` family
+(rate pinned 1.0 — every armed point fires) over a churned
+differential save loop with a live stdlib object-store server,
+foreground mirroring and a final fresh-dir pull-restore: every run
+must end *completed* or *typed* with the latest PROMOTED step
+restoring bit-equal through the manifest chain.  The wiped-disk
+scenario rides along: a world-2 sharded differential run mirrors out
+over HTTP, its local checkpoint directory is deleted outright, and a
+brand-new world-1 host must reshard-restore bit-equal PURELY from the
+remote tier — the spot-fleet replacement-host story, end to end.
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
                         [--serving-only] [--chaos-only]
-                        [--elastic-only] [--ps-only]
+                        [--diff-ckpt-only] [--elastic-only]
+                        [--ps-only]
 """
 
 from __future__ import annotations
@@ -1408,6 +1423,256 @@ def run_chaos_gate(k=8, timeout=150):
     }
 
 
+# The differential/remote checkpoint gate's worker (ISSUE 14).  Three
+# modes: "chaos" runs a churned differential save loop against a live
+# stdlib object-store server with foreground pushes and a final
+# pull-restore onto a fresh dir, under a seeded fault schedule the
+# DRIVER arms (DK_FAULTS_POINTS pinned to the save/GC/push/pull
+# family, rate 1.0 so every armed point fires); "check" restores the
+# run's latest PROMOTED step in a clean process and compares its
+# deterministic tree sha against what the worker printed at save
+# time; "wipe" is the spot-fleet acceptance — a world-2 sharded
+# differential run mirrors out over HTTP, its local checkpoint dir is
+# DELETED, and a brand-new world-1 host must reshard-restore
+# bit-equal purely from the remote tier.
+_DIFF_WORKER = r"""
+import json, os, shutil, sys, time
+
+mode, work = sys.argv[1], sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DK_CKPT_CHUNK_MB", "0.0625")   # 64 KB chunks
+os.environ.setdefault("DK_CKPT_DIFF", "1")
+os.environ.setdefault("DK_CKPT_GC_GRACE_S", "0")
+sys.path.insert(0, %REPO%)
+import numpy as np
+
+
+def tree_sha(tree):
+    # deterministic sorted-path walker (the ps-gate convention): the
+    # bit-equality verdict is a sha over every leaf's dtype+shape+bytes
+    import hashlib
+    h = hashlib.sha256()
+    def walk(t, path):
+        if isinstance(t, dict):
+            for k in sorted(t):
+                walk(t[k], path + "/" + str(k))
+        else:
+            a = np.asarray(t)
+            h.update(path.encode()); h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+    walk(tree, "")
+    return h.hexdigest()
+
+
+from dist_keras_tpu.checkpoint import Checkpointer
+from dist_keras_tpu.resilience import store as ckstore
+
+if mode == "chaos":
+    os.environ["DK_CKPT_ASYNC"] = "1"  # writer-thread instants covered
+    os.environ["DK_CKPT_REMOTE_PUSH"] = "0"  # pushes run FOREGROUND so
+    #                                          a ckpt.push kill is typed
+    srv = ckstore.ObjectStoreServer(os.path.join(work, "remote"))
+    srv.start()
+    os.environ["DK_CKPT_REMOTE"] = srv.url
+    saved = {}
+    try:
+        ck = Checkpointer(os.path.join(work, "ck"), max_to_keep=2)
+        up = ckstore.CheckpointUploader(ck)
+        w = np.arange(65536, dtype=np.float64)      # 8 chunks
+        frozen = np.arange(16384, dtype=np.int64)   # 2 frozen chunks
+        for i in range(1, 7):
+            w = w.copy()
+            w[: 8192 * (i % 3)] += float(i)         # partial churn
+            state = {"w": w, "frozen": frozen, "i": np.int64(i)}
+            ck.save(i, state).wait(timeout_s=30)
+            saved[i] = tree_sha(state)
+            print("SAVED %d %s" % (i, saved[i]), flush=True)
+            up.poll_once()                          # mirror, foreground
+        # the pull half under the same schedule: a FRESH dir restores
+        # the newest remote step bit-equal
+        fresh = Checkpointer(os.path.join(work, "fresh"))
+        step, got = fresh.restore()
+        assert tree_sha(got) == saved[int(step)], \
+            "pull-restore sha mismatch at step %s" % step
+        print("PULL_OK %d" % step, flush=True)
+        print("COMPLETED", flush=True)
+    except Exception as e:
+        print("TYPED %s: %s" % (type(e).__name__, str(e)[:200]),
+              flush=True)
+        sys.exit(3)
+    finally:
+        srv.close()
+elif mode == "check":
+    with open(sys.argv[3]) as f:
+        saved = json.load(f)
+    ck = Checkpointer(os.path.join(work, "ck"))
+    latest = ck.latest_step()
+    if latest is None:
+        # the schedule killed the run before its first promote: the
+        # invariant is vacuously held (nothing promoted, nothing owed)
+        print("CHECK_OK none", flush=True)
+        sys.exit(0)
+    assert ck.verify(latest) == "ok", "latest step failed verify"
+    step, got = ck.restore()
+    assert str(step) in saved, "restored unreported step %s" % step
+    assert tree_sha(got) == saved[str(step)], \
+        "sha mismatch at step %s" % step
+    print("CHECK_OK %d" % step, flush=True)
+elif mode == "wipe":
+    os.environ["DK_CKPT_ASYNC"] = "0"
+    from dist_keras_tpu.resilience import elastic
+
+    srv = ckstore.ObjectStoreServer(os.path.join(work, "remote"))
+    srv.start()
+    ckdir = os.path.join(work, "ck")
+    N = 131072
+    full = np.arange(N, dtype=np.float64) * 1.5
+    specs = {"w": 0, "i": None}
+    cks = [Checkpointer(ckdir, rank=r, world=2, commit_timeout_s=10)
+           for r in (0, 1)]
+    for step in (3, 4):
+        for r in (1, 0):   # leader LAST: its save promotes
+            shard = {"w": elastic.split_leaf(full, 0, 2, r),
+                     "i": np.int64(step)}
+            cks[r].save(step, shard,
+                        shard_specs=specs).wait(timeout_s=30)
+    assert cks[0].last_diff_stats["skipped"] > 0, \
+        "second save skipped nothing: differential path inert"
+    os.environ["DK_CKPT_REMOTE"] = srv.url
+    up = ckstore.CheckpointUploader(cks[0])
+    assert up.poll_once() == 2
+    # the machines die WITH their disks
+    shutil.rmtree(ckdir)
+    host = Checkpointer(os.path.join(work, "fresh_host"),
+                        rank=0, world=1)
+    step, got = host.restore()
+    assert step == 4, "restored %s, wanted the newest remote step" \
+        % step
+    np.testing.assert_array_equal(
+        np.asarray(got["w"], dtype=np.float64), full)
+    assert int(got["i"]) == 4
+    assert host.verify(step) == "ok"
+    srv.close()
+    print("WIPE_OK %d" % step, flush=True)
+"""
+
+# typed terminal set for the diff-ckpt chaos runs: FaultInjected (the
+# simulated kill), OSError/subclasses (exhausted transient retries,
+# store refusals, missing remote objects), CheckpointCorrupt.
+# TimeoutError is deliberately ABSENT — a handle wait expiring on
+# these tiny writes IS a hang and must fail the gate (the round-14
+# lesson).
+_DIFF_TYPED = ("FaultInjected", "OSError", "ConnectionError",
+               "FileNotFoundError", "StoreError", "CheckpointCorrupt")
+
+
+def run_diff_ckpt_gate(k=6, timeout=150):
+    """-> gate record for the differential + remote checkpoint gate:
+    K seeded chaos runs over the save/GC/push/pull fault family (each
+    must end completed or typed with the latest PROMOTED step
+    restoring bit-equal through the manifest chain) plus the
+    wiped-local-disk scenario (a fresh world-1 host reshard-restores
+    a world-2 run purely from the remote store)."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_diff_gate_")
+    script = os.path.join(work, "diff_worker.py")
+    with open(script, "w") as f:
+        f.write(_DIFF_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {kk: v for kk, v in os.environ.items()
+                if not kk.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                      "DK_CKPT", "DK_ALERT"))
+                and kk not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    runs = []
+    scenarios = {}
+    t0 = time.time()
+
+    def _run(mode, subdir, *extra, env_extra=None):
+        wdir = os.path.join(work, subdir)
+        os.makedirs(wdir, exist_ok=True)
+        env = dict(base_env)
+        env.update(env_extra or {})
+        p = subprocess.Popen(
+            [sys.executable, script, mode, wdir, *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True)
+        try:
+            out = p.communicate(timeout=timeout)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            return -9, "HANG: " + p.communicate()[0][-300:]
+        return p.returncode, out
+
+    try:
+        for seed in range(k):
+            rc, out = _run("chaos", f"seed_{seed}", env_extra={
+                "DK_FAULTS_SEED": str(4000 + seed),
+                "DK_FAULTS_RATE": "1.0",
+                "DK_FAULTS_POINTS": ("checkpoint.save,checkpoint"
+                                     ".commit,ckpt.write,ckpt.gc,"
+                                     "ckpt.push,ckpt.pull"),
+            })
+            verdict = {"seed": seed, "rc": rc,
+                       "hung": rc == -9 and out.startswith("HANG")}
+            if verdict["hung"]:
+                failures.append(f"seed {seed}: HANG (killed at "
+                                f"{timeout}s)")
+                runs.append({**verdict, "ok": False})
+                continue
+            if rc == 0 and "COMPLETED" not in out:
+                failures.append(f"seed {seed}: exited 0 without "
+                                f"completing: {out[-200:]}")
+            if rc != 0 and not any(
+                    f"TYPED {t}" in out for t in _DIFF_TYPED):
+                failures.append(f"seed {seed}: died UNTYPED "
+                                f"(rc={rc}): {out[-300:]}")
+            saved = dict(m.groups() for m in re.finditer(
+                r"^SAVED (\d+) ([0-9a-f]{64})$", out, re.M))
+            saved_path = os.path.join(work, f"seed_{seed}",
+                                      "saved.json")
+            with open(saved_path, "w") as f:
+                json.dump(saved, f)
+            crc, cout = _run("check", f"seed_{seed}", saved_path)
+            verdict["promoted"] = sorted(int(s) for s in saved)
+            verdict["completed"] = "COMPLETED" in out
+            verdict["check"] = cout.strip().splitlines()[-1] \
+                if cout.strip() else ""
+            if crc != 0 or "CHECK_OK" not in cout:
+                failures.append(f"seed {seed}: bit-equal restore "
+                                f"check failed: {cout[-300:]}")
+            verdict["ok"] = not any(fmsg.startswith(f"seed {seed}:")
+                                    for fmsg in failures)
+            runs.append(verdict)
+
+        rc, out = _run("wipe", "wipe")
+        scenarios["wiped_disk_remote_reshard"] = \
+            out.strip().splitlines()[-1] if out.strip() else f"rc={rc}"
+        if rc != 0 or "WIPE_OK" not in out:
+            failures.append(f"wiped-disk scenario failed (rc={rc}): "
+                            f"{out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "diff_ckpt_remote_tier",
+        "metric": "typed_or_completed_and_latest_restores_bit_equal"
+                  "_plus_wiped_disk_remote_reshard",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "k": k,
+        "runs": runs,
+        "scenarios": scenarios,
+        "failures": failures,
+    }
+
+
 # The elastic gate's worker entrypoint — shipped as the job directory's
 # main.py and launched by Job.supervise_run over the local transport
 # shim in _ELASTIC_DRIVER.  A deterministic "training" loop: a global
@@ -2560,6 +2825,14 @@ def main():
                          "bit-equal drain checkpoint, lapse/join "
                          "attribution, seeded ps.* chaos sweep) and "
                          "print its record")
+    ap.add_argument("--diff-ckpt-only", action="store_true",
+                    help="run just the differential + remote "
+                         "checkpoint gate (seeded chaos over the "
+                         "save/GC/push/pull fault family, every run "
+                         "ending restorable-bit-equal, plus the "
+                         "wiped-local-disk host restoring purely "
+                         "from the remote store) and print its "
+                         "record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -2582,6 +2855,11 @@ def main():
         ps_gate = run_ps_gate()
         print(json.dumps(ps_gate, indent=1))
         return 0 if ps_gate["passed"] else 1
+
+    if args.diff_ckpt_only:
+        diff_gate = run_diff_ckpt_gate()
+        print(json.dumps(diff_gate, indent=1))
+        return 0 if diff_gate["passed"] else 1
 
     if args.chaos_only:
         chaos_gate = run_chaos_gate()
@@ -2613,6 +2891,7 @@ def main():
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_chaos_gate())
+    res["gates"].append(run_diff_ckpt_gate())
     res["gates"].append(run_elastic_gate())
     res["gates"].append(run_ps_gate())
     res["gates"].append(run_watchdog_gate())

@@ -43,9 +43,9 @@ def test_zero_budget_still_yields_complete_record():
     # the loop COMPLETED (every config marked skipped, none lost)
     assert rec["partial"] is False
     # 9 device configs + CPU serving + CPU ckpt-manifest overhead
-    # + CPU ckpt-async-save + CPU retrace-proxy attribution
-    # + CPU reshard-restore
-    assert len(rec["configs"]) == 14
+    # + CPU ckpt-async-save + CPU diff-ckpt + CPU retrace-proxy
+    # attribution + CPU reshard-restore
+    assert len(rec["configs"]) == 15
     assert all(c.get("skipped") == "budget" for c in rec["configs"])
     # driver-contract top-level keys exist even with no headline run
     for key in ("metric", "value", "unit", "vs_baseline"):

@@ -1,0 +1,633 @@
+"""Differential checkpoints + the remote checkpoint tier (ISSUE 14):
+content-addressed chunk saves against the shared ``chunks/`` CAS dir,
+the retention-aware crash-safe chunk GC, the pluggable
+``CheckpointStore`` seam with its stdlib HTTP backend, the mirror
+protocol (``COMPLETE``-marker remote commits), and the remote
+fallbacks in ``restore`` / ``reshard_restore`` / the serving watcher.
+
+The invariants under test: a differential save restores BIT-EQUAL
+while writing only what churned; GC never collects a chunk any
+retained, quarantined or in-flight step references — through a
+mid-sweep kill; and a wiped-disk host restores (including reshard to a
+smaller world) purely from the remote tier.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from dist_keras_tpu.checkpoint import (
+    CAS_DIR_NAME,
+    CHUNKS_NAME,
+    GC_JOURNAL_NAME,
+    CheckpointCorrupt,
+    Checkpointer,
+)
+from dist_keras_tpu.resilience import FaultInjected, faults
+from dist_keras_tpu.resilience import store as ckstore
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def diff_env(monkeypatch):
+    """Small chunks + differential saves + synchronous writes (test
+    states are tiny; async adds nothing but scheduling noise here)."""
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0.0625")  # 64 KB
+    monkeypatch.setenv("DK_CKPT_DIFF", "1")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+
+
+def _state(i=1, churn=0):
+    """512 KB float leaf (8 chunks) + a frozen integer leaf (2 chunks)
+    + a small pickled tail.  ``churn`` rewrites the first N chunks of
+    the float leaf."""
+    w = np.arange(65536, dtype=np.float64)
+    if churn:
+        w = w.copy()
+        w[: churn * 8192] += float(i)
+    return {"w": w, "frozen": np.arange(16384, dtype=np.int64),
+            "i": np.int64(i)}
+
+
+def _cas(ck):
+    return os.path.join(ck.directory, CAS_DIR_NAME)
+
+
+def _cas_shas(payload):
+    """CAS shas referenced by one payload dir's chunks.json."""
+    with open(os.path.join(payload, CHUNKS_NAME)) as f:
+        meta = json.load(f)
+    shas = set()
+    for leaf in meta["leaves"]:
+        for rel in leaf["files"]:
+            head, name = os.path.split(rel)
+            assert os.path.basename(head) == "chunks"
+            shas.add(name)
+    return shas
+
+
+# ---------------------------------------------------------------------
+# differential saves
+# ---------------------------------------------------------------------
+
+def test_diff_save_round_trips_and_skips_unchanged(tmp_path, diff_env):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1)).wait()
+    assert ck.last_diff_stats["skipped"] == 0
+    full_bytes = ck.last_diff_stats["bytes_written"]
+    payload = tmp_path / "step_00000001"
+    # chunk bytes live in the CAS, not the payload dir
+    assert not [n for n in os.listdir(payload)
+                if n.startswith("chunk_")]
+    assert len(os.listdir(_cas(ck))) == 10  # 8 w + 2 frozen
+    # one churned chunk: 9 of 10 skipped, bytes written = one chunk
+    ck.save(2, _state(2, churn=1)).wait()
+    assert ck.last_diff_stats == {
+        "chunks": 10, "skipped": 9,
+        "bytes_written": 65536,
+        "bytes_skipped": full_bytes - 65536}
+    step, got = ck.restore()
+    assert step == 2
+    np.testing.assert_array_equal(got["w"], _state(2, churn=1)["w"])
+    np.testing.assert_array_equal(got["frozen"], _state(2)["frozen"])
+    assert got["frozen"].dtype == np.int64
+    assert ck.verify(1) == "ok" and ck.verify(2) == "ok"
+
+
+def test_rotted_cas_chunk_convicts_every_referencing_step(
+        tmp_path, diff_env):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1)).wait()
+    ck.save(2, _state(2, churn=1)).wait()
+    shared = sorted(_cas_shas(str(tmp_path / "step_00000001"))
+                    & _cas_shas(str(tmp_path / "step_00000002")))
+    assert shared  # frozen leaf + unchanged w chunks
+    tgt = os.path.join(_cas(ck), shared[0])
+    with open(tgt, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    for step in (1, 2):
+        with pytest.raises(CheckpointCorrupt) as ei:
+            ck.verify(step)
+        assert shared[0] in "; ".join(ei.value.problems)
+
+
+def test_diff_payload_restores_with_diff_and_chunking_off(
+        tmp_path, diff_env, monkeypatch):
+    """The CAS references recorded in chunks.json are plain relative
+    paths — a reader with every knob at its default follows them
+    without knowing the differential layer exists."""
+    s = _state(3, churn=2)
+    Checkpointer(str(tmp_path)).save(1, s).wait()
+    monkeypatch.delenv("DK_CKPT_DIFF")
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0")
+    step, got = Checkpointer(str(tmp_path)).restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+
+
+def test_diff_off_by_default_keeps_in_payload_chunks(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0.0625")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = tmp_path / "step_00000001"
+    assert [n for n in os.listdir(payload) if n.startswith("chunk_")]
+    assert not os.path.exists(_cas(ck))
+    assert ck.last_diff_stats is None
+
+
+def test_verify_off_disables_diff_with_the_hashing_it_needs(
+        tmp_path, diff_env, monkeypatch):
+    """DK_CKPT_VERIFY=0 opts out of hashing — and the differential
+    path's identities ARE hashes, so it degrades to the plain chunk
+    layout instead of silently re-charging the hash cost."""
+    monkeypatch.setenv("DK_CKPT_VERIFY", "0")
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state()).wait()
+    payload = tmp_path / "step_00000001"
+    assert [n for n in os.listdir(payload) if n.startswith("chunk_")]
+    assert not os.path.exists(_cas(ck))
+    step, got = ck.restore()
+    np.testing.assert_array_equal(got["w"], _state()["w"])
+
+
+def test_ctor_diff_flag_wins_over_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0.0625")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    ck = Checkpointer(str(tmp_path), diff=True)  # knob unset
+    ck.save(1, _state()).wait()
+    assert ck.last_diff_stats["chunks"] == 10
+    assert os.path.isdir(_cas(ck))
+
+
+# ---------------------------------------------------------------------
+# chunk GC
+# ---------------------------------------------------------------------
+
+def test_gc_shared_chunk_survives_retention_of_oldest(
+        tmp_path, diff_env, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    for i in range(1, 5):  # step 1 retired by the save of step 4
+        ck.save(i, _state(i, churn=1)).wait()
+    assert ck.all_steps() == [2, 3, 4]
+    # the frozen chunks + unchanged w chunks are shared across ALL
+    # retained steps and must survive; step 1's churned chunk is gone
+    for step in (2, 3, 4):
+        assert ck.verify(step) == "ok"
+        _s, got = ck.restore(step=step)
+        np.testing.assert_array_equal(got["w"],
+                                      _state(step, churn=1)["w"])
+    live = set()
+    for step in (2, 3, 4):
+        live |= _cas_shas(str(tmp_path / f"step_{step:08d}"))
+    assert set(os.listdir(_cas(ck))) == live
+
+
+def test_gc_respects_grace_window(tmp_path, diff_env, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "3600")
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    ck.save(1, _state(1)).wait()
+    ck.save(2, _state(2, churn=8)).wait()  # every w chunk rewritten
+    assert ck.all_steps() == [2]
+    # step 1's unique chunks are unreferenced but YOUNG: not collected
+    assert len(os.listdir(_cas(ck))) > 10
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    assert ck.gc_chunks(raise_errors=True) == 8
+    assert set(os.listdir(_cas(ck))) == _cas_shas(
+        str(tmp_path / "step_00000002"))
+
+
+def test_gc_quarantined_step_pins_its_chunks(
+        tmp_path, diff_env, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    ck.save(1, _state(1)).wait()
+    ck.save(2, _state(2, churn=2)).wait()
+    pinned = _cas_shas(str(tmp_path / "step_00000002"))
+    # rot the payload WITHOUT touching its chunk table: the quarantined
+    # evidence must keep pinning the chunks its table references
+    tgt = tmp_path / "step_00000002" / "small.pkl"
+    raw = bytearray(tgt.read_bytes())
+    raw[0] ^= 0xFF
+    tgt.write_bytes(bytes(raw))
+    step, _got = ck.restore()  # convicts 2, quarantines, falls back
+    assert step == 1
+    assert (tmp_path / "step_00000002.corrupt").is_dir()
+    assert ck.gc_chunks(raise_errors=True) == 0
+    assert pinned <= set(os.listdir(_cas(ck)))
+
+
+def test_gc_kill_mid_sweep_leaves_every_retained_step_restorable(
+        tmp_path, diff_env, monkeypatch):
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    ck.save(1, _state(1)).wait()
+    shutil.rmtree(str(tmp_path / "step_00000001"))  # orphan its chunks
+    with faults.armed("ckpt.gc"):
+        with pytest.raises(FaultInjected):
+            ck.gc_chunks(raise_errors=True)
+    journal = os.path.join(_cas(ck), GC_JOURNAL_NAME)
+    assert os.path.exists(journal)  # intent durable, nothing deleted
+    ck.save(2, _state(2)).wait()  # retained step written after the kill
+    assert ck.verify(2) == "ok"
+    _s, got = ck.restore()
+    np.testing.assert_array_equal(got["w"], _state(2)["w"])
+    # the next sweep finishes the job and retires the journal
+    ck.gc_chunks(raise_errors=True)
+    assert not os.path.exists(journal)
+    assert set(os.listdir(_cas(ck))) == _cas_shas(
+        str(tmp_path / "step_00000002"))
+
+
+def test_gc_failure_never_fails_the_save(tmp_path, diff_env,
+                                         monkeypatch):
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    ck.save(1, _state(1)).wait()
+    with faults.armed("ckpt.gc"):
+        # retention of step 1 makes its unique chunks candidates; the
+        # injected kill inside the sweep is absorbed — the SAVE is
+        # already committed and must report success
+        ck.save(2, _state(2, churn=8)).wait()
+    assert ck.latest_step() == 2
+    assert ck.verify(2) == "ok"
+
+
+def test_all_steps_and_orphan_gc_ignore_non_step_shaped(
+        tmp_path, diff_env):
+    """The `chunks/` CAS dir, the GC journal, and anything else not
+    step-shaped must never read as a step or be swept as orphaned
+    staging."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1)).wait()
+    os.makedirs(str(tmp_path / "step_backup"))  # operator scratch
+    with open(str(tmp_path / "step_notes.txt"), "w") as f:
+        f.write("ops notes\n")
+    journal = os.path.join(_cas(ck), GC_JOURNAL_NAME)
+    with open(journal, "w") as f:
+        f.write("{}\n")
+    assert ck.all_steps() == [1]
+    ck.save(2, _state(2)).wait()  # runs _gc_orphans + gc_chunks
+    assert os.path.isdir(str(tmp_path / "step_backup"))
+    assert os.path.exists(str(tmp_path / "step_notes.txt"))
+    assert os.path.isdir(_cas(ck))
+
+
+# ---------------------------------------------------------------------
+# the store seam
+# ---------------------------------------------------------------------
+
+def test_local_dir_store_round_trip(tmp_path):
+    s = ckstore.LocalDirStore(str(tmp_path / "store"))
+    s.put_bytes("chunks/abc", b"hello")
+    s.put_bytes("steps/step_00000001/manifest.json", b"{}")
+    assert s.get_bytes("chunks/abc") == b"hello"
+    assert s.exists("chunks/abc") and not s.exists("chunks/def")
+    assert s.list("steps/") == ["steps/step_00000001/manifest.json"]
+    s.delete("chunks/abc")
+    assert not s.exists("chunks/abc")
+    s.delete("chunks/abc")  # idempotent
+    with pytest.raises(FileNotFoundError):
+        s.get_bytes("chunks/abc")
+    with pytest.raises(ckstore.StoreError):
+        s.put_bytes("../escape", b"x")
+
+
+def test_http_store_round_trip_against_object_store_server(tmp_path):
+    with ckstore.ObjectStoreServer(str(tmp_path / "remote")) as srv:
+        s = ckstore.HTTPStore(srv.url)
+        s.put_bytes("chunks/abc", b"\x00\x01payload")
+        assert s.exists("chunks/abc") and not s.exists("chunks/nope")
+        assert s.get_bytes("chunks/abc") == b"\x00\x01payload"
+        s.put_bytes("steps/step_00000003/COMPLETE", b"{}")
+        assert s.list("steps/") == ["steps/step_00000003/COMPLETE"]
+        assert ckstore.remote_steps(s) == [3]
+        with pytest.raises(FileNotFoundError):
+            s.get_bytes("chunks/nope")
+        s.delete("chunks/abc")
+        assert not s.exists("chunks/abc")
+
+
+def test_store_from_url_dispatch(tmp_path):
+    assert isinstance(ckstore.store_from_url("http://127.0.0.1:1"),
+                      ckstore.HTTPStore)
+    assert isinstance(
+        ckstore.store_from_url(f"file://{tmp_path}/a"),
+        ckstore.LocalDirStore)
+    assert isinstance(ckstore.store_from_url(str(tmp_path / "b")),
+                      ckstore.LocalDirStore)
+    with pytest.raises(ValueError, match="https"):
+        ckstore.store_from_url("https://bucket")
+    assert ckstore.store_from_env() is None  # knob unset
+
+
+# ---------------------------------------------------------------------
+# the mirror protocol + uploader
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def remote(tmp_path, monkeypatch):
+    """A LocalDirStore remote wired through DK_CKPT_REMOTE."""
+    root = str(tmp_path / "remote")
+    monkeypatch.setenv("DK_CKPT_REMOTE", root)
+    monkeypatch.setenv("DK_CKPT_REMOTE_PUSH", "0")  # explicit pushes
+    return ckstore.LocalDirStore(root)
+
+
+def test_push_fetch_round_trip_bit_equal(tmp_path, diff_env, remote):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, _state(1)).wait()
+    ck.save(2, _state(2, churn=2)).wait()
+    up = ckstore.CheckpointUploader(ck)
+    assert up.poll_once() == 2
+    assert ckstore.remote_steps(remote) == [1, 2]
+    assert up.poll_once() == 0  # idempotent: nothing new
+    # the machine dies with its disk
+    shutil.rmtree(ck.directory)
+    fresh = Checkpointer(str(tmp_path / "fresh"))
+    step, got = fresh.restore()
+    assert step == 2
+    np.testing.assert_array_equal(got["w"], _state(2, churn=2)["w"])
+    assert fresh.verify(2) == "ok"
+
+
+def test_push_killed_mid_stream_leaves_no_complete_marker(
+        tmp_path, diff_env, remote):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, _state(1)).wait()
+    up = ckstore.CheckpointUploader(ck)
+    with faults.armed("ckpt.push", at=2):
+        with pytest.raises(FaultInjected):
+            up.poll_once()
+    assert ckstore.remote_steps(remote) == []  # invisible remotely
+    # the next poll re-pushes idempotently (already-up chunks reused)
+    assert up.poll_once() == 1
+    assert ckstore.remote_steps(remote) == [1]
+
+
+def test_pull_transient_absorbed_and_kill_typed(tmp_path, diff_env,
+                                                remote):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, _state(1)).wait()
+    ckstore.CheckpointUploader(ck).poll_once()
+    shutil.rmtree(ck.directory)
+    with faults.armed("ckpt.pull", exc=OSError):
+        fresh = Checkpointer(str(tmp_path / "f1"))
+        step, _got = fresh.restore()  # retry surface absorbs it
+        assert step == 1
+    with faults.armed("ckpt.pull", times=5):
+        fresh2 = Checkpointer(str(tmp_path / "f2"))
+        with pytest.raises((FaultInjected, FileNotFoundError)):
+            fresh2.restore()
+
+
+def test_restore_remote_heals_corrupt_local(tmp_path, diff_env,
+                                            remote, flip_one_byte):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    s = _state(5, churn=3)
+    ck.save(1, s).wait()
+    ckstore.CheckpointUploader(ck).poll_once()
+    flip_one_byte(str(tmp_path / "ck" / "step_00000001"))
+    step, got = ck.restore()
+    assert step == 1  # ZERO cadences lost: the clean remote copy wins
+    np.testing.assert_array_equal(got["w"], s["w"])
+    # the rotted copy was quarantined, the healed one re-promoted
+    assert (tmp_path / "ck" / "step_00000001.corrupt").is_dir()
+    assert ck.verify(1) == "ok"
+
+
+def test_restore_heals_rotted_cas_chunk_from_remote(tmp_path,
+                                                    diff_env, remote):
+    """Chunk bytes live in the CAS, so CAS rot is the dominant
+    corruption surface — the remote heal must re-hash an existing
+    local CAS entry before trusting it and re-download the clean
+    bytes (review finding: a bare exists-check kept the rotted chunk
+    and the 'healed' step re-convicted forever)."""
+    ck = Checkpointer(str(tmp_path / "ck"))
+    s = _state(4, churn=2)
+    ck.save(1, s).wait()
+    ckstore.CheckpointUploader(ck).poll_once()
+    sha = sorted(_cas_shas(str(tmp_path / "ck" / "step_00000001")))[0]
+    tgt = os.path.join(_cas(ck), sha)
+    with open(tgt, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    step, got = ck.restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+    assert ck.verify(1) == "ok"  # the CAS entry itself was replaced
+
+
+def test_truncated_cas_entry_is_rewritten_on_reuse(tmp_path,
+                                                   diff_env):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, _state(1)).wait()
+    sha = sorted(_cas_shas(str(tmp_path / "step_00000001")))[0]
+    tgt = os.path.join(_cas(ck), sha)
+    with open(tgt, "r+b") as f:
+        f.truncate(17)
+    ck.save(2, _state(2)).wait()  # same content: would-be reuse
+    assert os.path.getsize(tgt) > 17  # healed in place, not skipped
+    assert ck.verify(2) == "ok"
+    _s, got = ck.restore(step=2)
+    np.testing.assert_array_equal(got["w"], _state(2)["w"])
+
+
+def test_repushed_step_after_local_divergence(tmp_path, diff_env,
+                                              remote):
+    """A step number re-saved with DIFFERENT bytes (the run fell back
+    and overtook itself) must re-mirror over the stale remote copy —
+    the content-aware push skip (review finding: a bare
+    COMPLETE-marker check froze the stale copy forever, and the heal
+    path could resurrect parameters the run walked away from)."""
+    ck = Checkpointer(str(tmp_path / "ck"))
+    old = _state(1)
+    ck.save(1, old).wait()
+    ckstore.CheckpointUploader(ck).poll_once()
+    new = _state(1, churn=4)
+    ck.save(1, new).wait()  # journaled-swap overwrite, same step
+    up2 = ckstore.CheckpointUploader(ck)  # a RESTARTED process
+    assert up2.poll_once() == 1  # marker exists but content differs
+    shutil.rmtree(ck.directory)
+    step, got = Checkpointer(str(tmp_path / "fresh")).restore()
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], new["w"])
+
+
+def test_gc_journal_recovery_is_grace_exempt_for_untouched(
+        tmp_path, diff_env, monkeypatch):
+    """A crashed sweep's journaled candidates — verified unreferenced
+    and aged when the intent was recorded — finish collection on the
+    next sweep even inside a fresh grace window, provided nothing
+    touched them since (a touch means a save adopted the chunk)."""
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "0")
+    ck = Checkpointer(str(tmp_path), max_to_keep=1)
+    ck.save(1, _state(1)).wait()
+    orphaned = set(os.listdir(_cas(ck)))
+    shutil.rmtree(str(tmp_path / "step_00000001"))
+    with faults.armed("ckpt.gc"):
+        with pytest.raises(FaultInjected):
+            ck.gc_chunks(raise_errors=True)
+    # the restarted sweep runs under a LONG grace window: without the
+    # journal the young-mtime chunks would wait it out
+    monkeypatch.setenv("DK_CKPT_GC_GRACE_S", "3600")
+    assert ck.gc_chunks(raise_errors=True) == len(orphaned)
+    assert not os.path.exists(os.path.join(_cas(ck), GC_JOURNAL_NAME))
+    assert os.listdir(_cas(ck)) == []
+
+
+def test_mirror_works_for_plain_unchunked_payloads(tmp_path,
+                                                   monkeypatch,
+                                                   remote):
+    """The remote tier does not require the differential layer: a
+    legacy pickle/orbax payload mirrors as plain per-step files."""
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0")
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    ck = Checkpointer(str(tmp_path / "ck"))
+    s = {"w": np.arange(128, dtype=np.float32), "i": np.int64(7)}
+    ck.save(1, s).wait()
+    ckstore.CheckpointUploader(ck).poll_once()
+    shutil.rmtree(ck.directory)
+    step, got = Checkpointer(str(tmp_path / "fresh")).restore(
+        template=s)
+    assert step == 1
+    np.testing.assert_array_equal(got["w"], s["w"])
+
+
+def test_wiped_host_reshards_world2_to_world1_from_remote(
+        tmp_path, diff_env, remote):
+    from dist_keras_tpu.resilience import elastic
+
+    ckdir = str(tmp_path / "ck")
+    full = np.arange(65536, dtype=np.float64) * 1.5
+    specs = {"w": 0, "i": None}
+    cks = [Checkpointer(ckdir, rank=r, world=2, commit_timeout_s=10)
+           for r in (0, 1)]
+    for r in (1, 0):  # leader last: its save promotes
+        shard = {"w": elastic.split_leaf(full, 0, 2, r),
+                 "i": np.int64(3)}
+        cks[r].save(3, shard, shard_specs=specs).wait(timeout_s=30)
+    assert ckstore.CheckpointUploader(cks[0]).poll_once() == 1
+    shutil.rmtree(ckdir)
+    fresh = Checkpointer(str(tmp_path / "fresh"), rank=0, world=1)
+    step, got = fresh.restore()
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(got["w"], dtype=np.float64), full)
+    assert int(got["i"]) == 3
+
+
+def test_uploader_background_thread_and_auto_arm(tmp_path, diff_env,
+                                                 monkeypatch):
+    import time
+
+    root = str(tmp_path / "remote")
+    monkeypatch.setenv("DK_CKPT_REMOTE", root)
+    monkeypatch.setenv("DK_CKPT_REMOTE_POLL_S", "0.05")
+    ck = Checkpointer(str(tmp_path / "ck"))
+    try:
+        ck.save(1, _state(1)).wait()  # save() arms the uploader
+        assert ck._uploader is not None
+        store = ckstore.LocalDirStore(root)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if ckstore.remote_steps(store) == [1]:
+                break
+            time.sleep(0.02)
+        assert ckstore.remote_steps(store) == [1]
+    finally:
+        ck.stop_uploader()
+    assert ck._uploader is None
+
+
+def test_uploader_push_off_keeps_tier_read_only(tmp_path, diff_env,
+                                                monkeypatch):
+    monkeypatch.setenv("DK_CKPT_REMOTE", str(tmp_path / "remote"))
+    monkeypatch.setenv("DK_CKPT_REMOTE_PUSH", "0")
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, _state(1)).wait()
+    assert ck._uploader is None
+    assert ck.remote_steps() == []
+
+
+# ---------------------------------------------------------------------
+# the serving watcher's remote fallback
+# ---------------------------------------------------------------------
+
+class _FakeEngine:
+    def __init__(self):
+        self.swaps = []
+
+    def set_params(self, state, step=None):
+        self.swaps.append(step)
+
+
+def test_watcher_pull_through_fetches_remote_steps(tmp_path, diff_env,
+                                                   remote):
+    from dist_keras_tpu.serving.reload import CheckpointWatcher
+
+    trainer_ck = Checkpointer(str(tmp_path / "trainer"))
+    trainer_ck.save(1, _state(1)).wait()
+    ckstore.CheckpointUploader(trainer_ck).poll_once()
+    # the serving host: its OWN (empty) cache dir + the remote tier
+    cache_ck = Checkpointer(str(tmp_path / "cache"))
+    eng = _FakeEngine()
+    w = CheckpointWatcher(eng, cache_ck, poll_s=0.05)
+    assert w.poll_once() == 1
+    assert eng.swaps == [1]
+    assert cache_ck.latest_step() == 1  # pulled through
+
+
+def test_watcher_heals_convicted_candidate_from_remote(
+        tmp_path, diff_env, remote, flip_one_byte):
+    from dist_keras_tpu.serving.reload import CheckpointWatcher
+
+    trainer_ck = Checkpointer(str(tmp_path / "trainer"))
+    s = _state(9, churn=4)
+    trainer_ck.save(1, s).wait()
+    ckstore.CheckpointUploader(trainer_ck).poll_once()
+    cache_ck = Checkpointer(str(tmp_path / "cache"))
+    cache_ck.fetch_remote(1)
+    flip_one_byte(str(tmp_path / "cache" / "step_00000001"))
+    eng = _FakeEngine()
+    w = CheckpointWatcher(eng, cache_ck, initial_step=0)
+    assert w.poll_once() == 1  # convicted once, re-fetched clean
+    assert eng.swaps == [1]
+    assert w.skipped_corrupt == 0
+    assert cache_ck.verify(1) == "ok"
+
+
+# ---------------------------------------------------------------------
+# registries
+# ---------------------------------------------------------------------
+
+def test_diff_remote_knobs_events_metrics_faults_registered():
+    from dist_keras_tpu.observability.events import KNOWN_EVENTS
+    from dist_keras_tpu.observability.metrics import KNOWN_METRICS
+    from dist_keras_tpu.resilience.faults import KNOWN_POINTS
+    from dist_keras_tpu.utils import knobs
+
+    for name in ("DK_CKPT_DIFF", "DK_CKPT_GC_GRACE_S",
+                 "DK_CKPT_REMOTE", "DK_CKPT_REMOTE_PUSH",
+                 "DK_CKPT_REMOTE_POLL_S"):
+        assert name in knobs.KNOBS
+    for ev in ("ckpt_diff", "ckpt_gc", "ckpt_push", "ckpt_pull"):
+        assert ev in KNOWN_EVENTS
+    assert KNOWN_METRICS["ckpt.chunks_skipped"] == "counter"
+    assert KNOWN_METRICS["ckpt.bytes_pushed"] == "counter"
+    for point in ("ckpt.gc", "ckpt.push", "ckpt.pull"):
+        assert point in KNOWN_POINTS

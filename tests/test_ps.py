@@ -397,6 +397,53 @@ def test_cold_start_without_params_or_checkpoint_is_actionable(tmp_path):
         PSServer(params=None, port=0, ckpt_dir=str(tmp_path / "empty"))
 
 
+def test_center_restart_restores_bit_equal_from_differential_save(
+        tmp_path, monkeypatch):
+    """The PS center's periodic checkpoint routes through the round-18
+    DIFFERENTIAL path (the server's Checkpointer is built diff=True):
+    with chunk-sized leaves, a churned center rewrites only the chunks
+    that moved — the frozen integer RNG-state leaf hashes identical
+    save over save and is SKIPPED — and a restarted center restores
+    bit-equal from the differential chain."""
+    monkeypatch.setenv("DK_CKPT_CHUNK_MB", "0.0625")  # 64 KB chunks
+    monkeypatch.setenv("DK_CKPT_ASYNC", "0")
+    params = {
+        "dense": {"w": np.arange(65536, dtype=np.float32)},  # 4 chunks
+        "rng": np.arange(16384, dtype=np.uint32),  # 1 frozen chunk
+    }
+    ck = str(tmp_path / "ck")
+    srv = PSServer(params=params, port=0, ckpt_dir=ck,
+                   ckpt_every_commits=1)
+    try:
+        delta = {"dense": {"w": np.full(65536, 0.5, np.float32)},
+                 "rng": np.zeros((), np.int32)}
+        info = srv.center.commit("w0", 0, delta)
+        assert srv.checkpoint_now() == info["version"]
+        full = srv._ckptr.last_diff_stats
+        assert full["chunks"] == 5 and full["skipped"] == 0
+        info = srv.center.commit("w0", info["version"], delta)
+        assert srv.checkpoint_now() == info["version"] == 2
+        diffed = srv._ckptr.last_diff_stats
+        # every float chunk churned; the integer RNG chunk (which
+        # apply_commit never moves) was skipped, not rewritten
+        assert diffed["skipped"] == 1
+        assert diffed["bytes_skipped"] == params["rng"].nbytes
+        _clock, center_live = srv.center.state()
+    finally:
+        srv.close()
+    srv2 = PSServer(port=0, ckpt_dir=ck)
+    try:
+        assert srv2.restored_step == 2 and srv2.center.clock == 2
+        _c, center_restored = srv2.center.state()
+        np.testing.assert_array_equal(center_restored["dense"]["w"],
+                                      center_live["dense"]["w"])
+        np.testing.assert_array_equal(center_restored["rng"],
+                                      center_live["rng"])
+        assert center_restored["rng"].dtype == np.uint32
+    finally:
+        srv2.close()
+
+
 def test_healthz_metricsz(ps_server):
     import json
     import urllib.request
