@@ -1085,6 +1085,163 @@ def bench_ckpt_manifest(peak=None, mb=64, reps=5, timeout_s=300):
         timeout_s=timeout_s)
 
 
+# The comm-overlap worker: CPU-pinned proxy for the DK_COMM_OVERLAP win.
+# The device-only claim ("the psum rides ICI under window k+1's
+# compute") cannot be measured on this image, but its HOST-side shape
+# can: the wall the training loop spends BLOCKED at a window boundary
+# before the next window's compute is enqueued.  Blocked mode pays
+# dispatch + block_until_ready there; overlapped mode (AsyncMerge) pays
+# only the async enqueue, with the block_until_ready deferred one
+# window — the same double-buffer trick ChunkFeed plays for H2D.  The
+# perf.phase comm_blocked/comm_overlap split is reported from the same
+# run so the attribution story is exercised end to end.
+_COMM_OVERLAP_WORKER = r"""
+import json, os, statistics, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from dist_keras_tpu.observability import metrics
+from dist_keras_tpu.parallel.collectives import AsyncMerge
+
+n, windows = int(sys.argv[1]), int(sys.argv[2])
+center = {"w": jnp.ones((n,), jnp.float32),
+          "b": jnp.ones((n // 4,), jnp.float32)}
+delta = {"w": jnp.full((n,), 1e-6, jnp.float32),
+         "b": jnp.full((n // 4,), 1e-6, jnp.float32)}
+
+
+def merge_fn(c, d):
+    # a multi-pass merge so the collective-analog has a measurable wall
+    for _ in range(8):
+        c = jax.tree.map(lambda x, y: x + 0.125 * y, c, d)
+    return c
+
+
+compute = jax.jit(lambda x: jnp.tanh(x @ x) @ x)
+merge = jax.jit(merge_fn)
+xw = jnp.ones((256, 256), jnp.float32)
+# warm both executables outside the clock
+jax.block_until_ready(compute(xw))
+center = jax.block_until_ready(merge(center, delta))
+
+
+def run_blocked():
+    global center
+    walls = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        center = merge(center, delta)
+        jax.block_until_ready(center)      # the boundary stall
+        walls.append(time.perf_counter() - t0)
+        jax.block_until_ready(compute(xw))  # next window's local steps
+    return walls
+
+
+def run_overlapped():
+    global center
+    am = AsyncMerge(merge_fn)
+    walls = []
+    out = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        am.submit(center, delta)            # async enqueue only
+        walls.append(time.perf_counter() - t0)
+        out = compute(xw)                   # dispatched before the wait
+        center = am.wait()                  # deferred one window
+    jax.block_until_ready(out)
+    return walls
+
+
+blocked = run_blocked()
+overlapped = run_overlapped()
+h = metrics.snapshot()["histograms"]
+split = {k[len("perf.phase."):]: {"count": v["count"],
+                                  "total_s": round(v["total"], 6)}
+         for k, v in h.items()
+         if k.startswith("perf.phase.comm_")}
+b, o = statistics.median(blocked), statistics.median(overlapped)
+print(json.dumps({
+    "windows": windows,
+    "tree_mb": round((n + n // 4) * 4 / 2**20, 2),
+    "blocked_boundary_wall_s": round(b, 6),
+    "overlapped_boundary_wall_s": round(o, 6),
+    "boundary_wall_ratio": round(o / b, 4) if b else None,
+    "phase_split": split,
+}))
+"""
+
+
+def bench_comm_overlap(peak=None, n=1 << 21, windows=16, timeout_s=300):
+    """Overlapped-window-collective proxy (``comm_overlap``): the
+    host wall spent blocked at a window boundary, blocked merge vs
+    ``AsyncMerge`` (async submit, ``block_until_ready`` deferred one
+    window), on a CPU-pinned subprocess — the measurable half of the
+    DK_COMM_OVERLAP story while the device backend is down, plus the
+    ``perf.phase.comm_blocked``/``comm_overlap`` attribution split.
+    No ``vs_baseline`` (an internal blocked-vs-overlapped ratio)."""
+    return _run_cpu_worker(
+        "comm_overlap", source=_COMM_OVERLAP_WORKER,
+        args=(n, windows), strip_prefixes=("DK_COMM",),
+        timeout_s=timeout_s)
+
+
+# The PS-compression worker: commit payload bytes + encode/decode wall
+# per DK_PS_COMPRESS variant on an MLP-shaped float32 delta — the
+# ROADMAP round-17 "delta compression for WAN-separated workers"
+# follow-up, measured.  Pure numpy host work: runs identically with the
+# device tunnel wedged.
+_PS_COMPRESS_WORKER = r"""
+import json, os, statistics, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from dist_keras_tpu.ps import compress
+
+mb, reps = float(sys.argv[1]), int(sys.argv[2])
+rng = np.random.default_rng(0)
+n = int(mb * 2**20 / 4)
+delta = {"dense": {"w": rng.normal(size=(n * 3 // 4,)
+                                   ).astype(np.float32) * 1e-3,
+                   "b": rng.normal(size=(n // 4,)
+                                   ).astype(np.float32) * 1e-3},
+         "seed": np.zeros((), np.int32)}
+raw_bytes = compress.payload_nbytes(delta)
+rows = []
+for spec_s in (None, "fp16", "int8", "int8@0.1"):
+    spec = compress.parse_spec(spec_s)
+    enc_walls, dec_walls = [], []
+    wire = delta
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        wire = compress.encode_tree(delta, spec)
+        enc_walls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        dec = compress.decode_tree(wire)
+        dec_walls.append(time.perf_counter() - t0)
+    wire_bytes = compress.payload_nbytes(wire)
+    rows.append({
+        "spec": spec_s or "off",
+        "payload_bytes": wire_bytes,
+        "bytes_ratio": round(raw_bytes / wire_bytes, 3),
+        "encode_wall_s": round(statistics.median(enc_walls), 5),
+        "decode_wall_s": round(statistics.median(dec_walls), 5),
+    })
+print(json.dumps({"raw_bytes": raw_bytes, "reps": reps, "rows": rows}))
+"""
+
+
+def bench_ps_compress(peak=None, mb=8, reps=5, timeout_s=300):
+    """PS commit-delta compression (``ps_compress``): payload bytes +
+    encode/decode wall per ``DK_PS_COMPRESS`` variant on an
+    ``mb``-MB MLP-shaped delta, CPU-pinned subprocess.  The acceptance
+    floor tracked per round: int8 >= 2x byte reduction.  No
+    ``vs_baseline`` (the reference ships full pickled weights)."""
+    return _run_cpu_worker(
+        "ps_compress", source=_PS_COMPRESS_WORKER,
+        args=(mb, reps), strip_prefixes=("DK_PS",),
+        timeout_s=timeout_s)
+
+
 def _backend_responsive(timeout_s=180):
     """Probe the default backend in a SUBPROCESS with a hard timeout.
 
@@ -1240,7 +1397,11 @@ def main():
                                   (bench_retrace_proxy,
                                    "bench_retrace_proxy"),
                                   (bench_reshard_restore,
-                                   "reshard_restore")):
+                                   "reshard_restore"),
+                                  (bench_comm_overlap,
+                                   "comm_overlap"),
+                                  (bench_ps_compress,
+                                   "ps_compress")):
             t0 = time.time()
             _obs_emit("bench_config_begin", name=fn.__name__)
             try:
@@ -1271,6 +1432,7 @@ def main():
                bench_adag_streamed, bench_serving, bench_ckpt_manifest,
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,
+               bench_comm_overlap, bench_ps_compress,
                bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:

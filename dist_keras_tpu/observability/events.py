@@ -111,6 +111,8 @@ KNOWN_EVENTS = (
     # parameter-server training mode (ps/)
     "ps_pull", "ps_commit", "ps_stale_scaled",
     "ps_worker_join", "ps_worker_lapse",
+    # fused flash backward graduation (ops/pallas)
+    "fused_bwd_rejected",
     # telemetry plane (observability/)
     "perf_sample", "watchdog_alert", "watchdog_clear",
     "metrics_exporter_listen", "flight_dump",

@@ -200,6 +200,10 @@ KNOWN_METRICS = {
     "ps.workers": "gauge",
     "ps.clock": "gauge",
     "ps.staleness": "histogram",
+    # PS commit-delta compression (ps/worker.py): payload array bytes
+    # before/after the DK_PS_COMPRESS codec — equal when it is off
+    "ps.commit_bytes_raw": "counter",
+    "ps.commit_bytes_wire": "counter",
     # perf attribution (observability/perf.py)
     "perf.retraces": "counter",
     "perf.traces": "counter",

@@ -56,7 +56,12 @@ _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _lock = threading.Lock()
 _installed = False
 
-PHASES = ("data", "step", "comm", "ckpt")
+# comm_overlap / comm_blocked are the round-19 split of the boundary
+# collective wall: AsyncMerge (parallel/collectives.py) charges the
+# async enqueue to comm_overlap and the deferred block_until_ready to
+# comm_blocked, so "how much of the collective hid under compute" is a
+# first-class histogram instead of a guess inside "comm"
+PHASES = ("data", "step", "comm", "comm_overlap", "comm_blocked", "ckpt")
 
 
 def _on_duration(name, duration_secs, **kw):

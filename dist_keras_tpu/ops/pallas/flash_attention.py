@@ -418,6 +418,29 @@ def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, q_offset,
     return (out, lse), (q, k, v, out, lse)
 
 
+def _fused_bwd_graduated(q, k, causal, block_q, block_k, q_offset,
+                         kv_offset, interpret):
+    """DK_FUSED_BWD routing predicate, decided at TRACE time: True only
+    when the flag is on AND the cached per-(shape, blocking, compiler)
+    ``selfcheck()`` parity run came back EXACT for this configuration.
+    A mismatch (or an unverifiable backend) caches a rejection verdict
+    + one ``fused_bwd_rejected`` event and the reference two-kernel
+    backward keeps serving — the typed fallback, never silent
+    corruption (the experiment module's coherence table is the
+    contract)."""
+    from dist_keras_tpu.utils import knobs
+
+    if not knobs.get("DK_FUSED_BWD"):
+        return False
+    from dist_keras_tpu.ops.pallas import fused_bwd_experimental as fused
+
+    bh, tq, d = q.shape
+    verdict = fused.graduate(
+        bh, tq, k.shape[1], d, q.dtype, causal, block_q, block_k,
+        q_offset=q_offset, kv_offset=kv_offset, interpret=interpret)
+    return verdict.status == "exact"
+
+
 def _flash_core_bwd(causal, scale, block_q, block_k, q_offset, kv_offset,
                     interpret, res, cts):
     q, k, v, out, lse = res
@@ -428,6 +451,15 @@ def _flash_core_bwd(causal, scale, block_q, block_k, q_offset, kv_offset,
     g_lse = (jnp.zeros_like(delta) if g_lse is None
              else g_lse.astype(jnp.float32))
     dl = g_lse - delta
+    if _fused_bwd_graduated(q, k, causal, block_q, block_k, q_offset,
+                            kv_offset, interpret):
+        from dist_keras_tpu.ops.pallas.fused_bwd_experimental import (
+            fused_bwd_call,
+        )
+
+        return fused_bwd_call(q, k, v, g_out, lse, dl, causal, scale,
+                              block_q, block_k, q_offset, kv_offset,
+                              interpret=interpret)
     dq, dk, dv = _bwd_call(q, k, v, g_out, lse, dl, causal, scale,
                            block_q, block_k, q_offset, kv_offset, interpret)
     return dq, dk, dv

@@ -52,6 +52,7 @@ from dist_keras_tpu.resilience import preemption
 from dist_keras_tpu.utils import knobs
 from dist_keras_tpu.utils.serialization import (pickle_object,
                                                 unpickle_object)
+from dist_keras_tpu.ps import compress
 from dist_keras_tpu.ps.center import CenterVariable, StaleCommit
 
 
@@ -206,13 +207,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _commit_inner(self, srv, doc, wid, version, delta):
         try:
             with spans.span("ps.commit", wid=wid, version=version):
+                # dequantize a DK_PS_COMPRESS wire delta to float32
+                # BEFORE DynSGD scaling — the center-update algebra
+                # (the dynsgd.py bit-parity surface) stays codec-blind;
+                # a plain float32 tree passes through untouched
+                delta = compress.decode_tree(delta)
                 info = srv.center.commit(
                     wid, version, delta,
                     commit_id=doc.get("commit_id"),
                     rank=doc.get("rank"))
         except (KeyError, IndexError, ValueError, TypeError) as e:
             # a structurally-foreign delta (wrong pytree keys / leaf
-            # shapes — a worker built against a different model) is
+            # shapes — a worker built against a different model, or a
+            # malformed compressed record) is
             # the CALLER's bug: a typed 400, never a dead handler the
             # client would misread as an unreachable server
             self._reply_json(400, {

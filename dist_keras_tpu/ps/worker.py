@@ -35,8 +35,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.trainers.base import Trainer
 from dist_keras_tpu.utils import knobs
+from dist_keras_tpu.ps import compress as _compress
 from dist_keras_tpu.ps.center import StaleCommit
 from dist_keras_tpu.ps.client import PSClient
 
@@ -75,6 +77,13 @@ def _window_delta(local, pulled):
         local, pulled)
 
 
+def _add_floats(a, b):
+    """``a + b`` per float leaf, ``a`` elsewhere — how the error-
+    feedback residual folds into the next window's delta."""
+    return jax.tree.map(
+        lambda x, y: x + y if _float_leaf(x) else x, a, b)
+
+
 class PSWorkerTrainer(Trainer):
     """One elastic async worker against a center-variable server.
 
@@ -87,9 +96,15 @@ class PSWorkerTrainer(Trainer):
 
     def __init__(self, keras_model, server_addr=None,
                  communication_window=None, worker_id=None,
-                 client=None, **kw):
+                 client=None, compress=None, **kw):
         super().__init__(keras_model, **kw)
         self.server_addr = server_addr
+        # delta compression: None defers to DK_PS_COMPRESS at train()
+        # time; an explicit spec string ("fp16", "int8", "int8@0.1")
+        # pins it per trainer.  Malformed specs fail loudly HERE.
+        self.compress = compress
+        if compress is not None:
+            _compress.parse_spec(compress)
         if communication_window is not None \
                 and int(communication_window) < 1:
             raise ValueError(
@@ -103,6 +118,10 @@ class PSWorkerTrainer(Trainer):
         self._client = client
         self.commit_log = []  # [(version, staleness, scale)] applied
         self.stale_rejections = 0  # over-cap commits refused typed
+        # payload bytes shipped (array bytes, pickle framing excluded):
+        # raw = the float32 delta, wire = what actually went out —
+        # equal when compression is off, the compression win otherwise
+        self.commit_bytes = {"raw": 0, "wire": 0}
 
     def _make_client(self):
         if self._client is not None:
@@ -176,6 +195,14 @@ class PSWorkerTrainer(Trainer):
         t = 0
         epoch_t0 = time.time()
         center = joined["center"]
+        # delta compression (DK_PS_COMPRESS): the error-feedback
+        # residual holds what the codec dropped from the LAST shipped
+        # window; it folds into the next delta so compression error
+        # never biases convergence, only delays information
+        spec = _compress.resolve_spec(self.compress)
+        residual = None
+        raw_ctr = _metrics.counter("ps.commit_bytes_raw")
+        wire_ctr = _metrics.counter("ps.commit_bytes_wire")
         try:
             while t < total_t:
                 # windows align to epoch boundaries so per-epoch
@@ -191,18 +218,34 @@ class PSWorkerTrainer(Trainer):
                 t += T
                 # commit the window; adopt the fresh center either way
                 delta = _window_delta(params, pulled)
+                if spec is not None and residual is not None:
+                    delta = _add_floats(delta, residual)
+                wire = _compress.encode_tree(delta, spec)
+                raw_b = _compress.payload_nbytes(delta)
+                wire_b = (raw_b if spec is None
+                          else _compress.payload_nbytes(wire))
+                raw_ctr.inc(raw_b)
+                wire_ctr.inc(wire_b)
+                self.commit_bytes["raw"] += raw_b
+                self.commit_bytes["wire"] += wire_b
                 try:
                     resp = client.commit(self.worker_id, version,
-                                         delta,
+                                         wire,
                                          rank=self._coord_rank())
                     self.commit_log.append(
                         (resp["version"], resp["staleness"],
                          resp["scale"]))
                     version, center = resp["version"], resp["center"]
+                    if spec is not None:
+                        residual = _compress.residual_update(delta, wire)
                 except StaleCommit:
                     # over the cap: this window's delta is refused —
-                    # drop it, re-pull, keep going (bounded damage)
+                    # drop it, re-pull, keep going (bounded damage).
+                    # The residual goes with it: error feedback tracks
+                    # APPLIED commits only, and re-shipping a refused
+                    # window's error would smuggle the capped delta in
                     self.stale_rejections += 1
+                    residual = None
                     fresh = client.pull(self.worker_id)
                     version, center = fresh["version"], fresh["center"]
                 params = _merge_center(center, params)

@@ -126,7 +126,8 @@ KNOWN_POINTS = (
     "punchcard.read_manifest", "stream.fetch", "step.loss",
     "serve.enqueue", "serve.predict", "serve.reload",
     "reshard.load", "reshard.scatter",
-    "ps.pull", "ps.commit", "ps.join",
+    "ps.pull", "ps.commit", "ps.join", "ps.encode",
+    "comm.merge",
 )
 
 

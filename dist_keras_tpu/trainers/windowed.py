@@ -37,16 +37,22 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
-from dist_keras_tpu.parallel.collectives import tree_psum, tree_pvary
+from dist_keras_tpu.parallel.collectives import (
+    AsyncMerge,
+    tree_psum,
+    tree_pvary,
+)
 from dist_keras_tpu.parallel.mesh import WORKER_AXIS
 from dist_keras_tpu.comm import backend as comm
 from dist_keras_tpu.trainers.base import DistributedTrainer
 from dist_keras_tpu.trainers.chunking import init_streaming, run_chunked
+from dist_keras_tpu.utils import knobs
 from dist_keras_tpu.utils.pytree import (
     tree_add,
     tree_merge_floats,
     tree_scale,
     tree_sub,
+    tree_zeros_like,
 )
 
 try:
@@ -67,10 +73,16 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     def __init__(self, keras_model, num_workers=2, communication_window=5,
                  parallelism_factor=1, checkpoint_every_windows=None,
-                 stream_chunk_windows=None, max_resident_bytes=None, **kw):
+                 stream_chunk_windows=None, max_resident_bytes=None,
+                 comm_overlap=None, **kw):
         super().__init__(keras_model, num_workers=num_workers, **kw)
         self.communication_window = int(communication_window)
         self.parallelism_factor = int(parallelism_factor)
+        # overlapped window collectives (round 19): None defers to the
+        # DK_COMM_OVERLAP knob at train() time (launcher-export wins),
+        # an explicit bool pins it per trainer
+        self.comm_overlap = comm_overlap
+        self._overlap = False  # resolved per train() call
         # window-granular checkpoint cadence: a preemption then loses at
         # most ``checkpoint_every_windows`` communication windows, not a
         # whole epoch (the reference's big-DataFrame case,
@@ -93,7 +105,10 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     def _cache_extras(self):
         # the per-chunk epoch count is appended via _compiled(extra_key=)
-        return super()._cache_extras() + (self.communication_window,)
+        # (the overlap flag changes the scan carry STRUCTURE, so it must
+        # key the executable cache too)
+        return super()._cache_extras() + (self.communication_window,
+                                          self._overlap)
 
     # --- strategy hooks -------------------------------------------------
     def wrap_optimizer(self, tx):
@@ -101,8 +116,37 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     def merge(self, center, local):
         """(center, local) -> (center', local'), called once per window with
-        the worker axis bound."""
+        the worker axis bound.  The BLOCKED merge — kept verbatim so
+        ``DK_COMM_OVERLAP=0`` compiles byte-identical window bodies to
+        every round before the overlap existed."""
         raise NotImplementedError
+
+    # --- overlap decomposition (DK_COMM_OVERLAP) ------------------------
+    # The blocked ``merge`` is algebraically  commit -> psum -> apply ->
+    # absorb  with the apply consumed IMMEDIATELY.  The overlapped path
+    # splits those so the psum's result has no consumer until the NEXT
+    # window boundary (the one-window-stale center — exactly the paper's
+    # async commit model, where a worker's commit is "in flight" while
+    # it already trains on): XLA is then free to run the collective
+    # concurrently with window k+1's local steps, and the host-level
+    # ``AsyncMerge`` flush at the end of train() plays the same trick
+    # for the final pending commit.
+    def commit(self, center, local):
+        """The worker's window commit delta (pre-psum), computed against
+        the center this window's local steps started from."""
+        raise NotImplementedError(
+            f"{type(self).__name__} defines no commit/absorb overlap "
+            "decomposition — DK_COMM_OVERLAP needs both (or run this "
+            "trainer with the blocked merge: comm_overlap=False)")
+
+    def absorb(self, center, local, delta):
+        """The worker-local post-commit update: ``center`` is the
+        (one-window-stale) merged center the worker syncs to, ``delta``
+        its OWN just-committed delta (pre-psum)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} defines no commit/absorb overlap "
+            "decomposition — DK_COMM_OVERLAP needs both (or run this "
+            "trainer with the blocked merge: comm_overlap=False)")
 
     def _ckpt_cadence_windows(self, wpe):
         """Save cadence in WINDOW units — the single source both the
@@ -136,6 +180,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         true epoch-boundary chunking."""
         model, loss_fn, tx = self._resolve()
         tx = self.wrap_optimizer(tx)
+        # overlapped window collectives: resolved per call so a
+        # launcher-exported DK_COMM_OVERLAP wins regardless of when the
+        # trainer was constructed (the knobs-registry contract)
+        overlap = self._overlap = bool(
+            self.comm_overlap if self.comm_overlap is not None
+            else knobs.get("DK_COMM_OVERLAP"))
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         xs, ys = self._shards(dataset)  # (workers, steps, batch, ...)
@@ -172,8 +222,67 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             one dispatch).  Streaming mode: ONLY the chunk's (K, W, ...)
             slice arrives and the scan consumes it directly — identical
             window algebra, so the two paths are bit-equal on the same
-            data (asserted in tests/test_streaming_feed.py)."""
-            def body(center, local, opt_state, rng, xs, ys, key, g0):
+            data (asserted in tests/test_streaming_feed.py).
+
+            Under ``overlap`` (DK_COMM_OVERLAP) the carry grows a
+            replicated ``pending`` leaf set — the previous window's
+            psum'd commit, applied ONE window late.  The psum issued at
+            boundary k has no consumer until boundary k+1, so it
+            carries no data dependency into window k+1's local steps
+            and the compiler overlaps the collective with them; the
+            algebra is the paper's async model (every worker trains on
+            a center missing exactly the cluster's last window of
+            commits).  ``pending`` rides the scan carry, the chunk
+            carry AND the checkpoint state, so the staleness semantics
+            are chunk-plan-invariant (gates.py --speed-only pins a
+            per-window-dispatched run bit-equal to the fused one)."""
+            def window(carry, g, xw, yw, widx, key):
+                if overlap:
+                    center, pending, local, opt_state, rng = carry
+                else:
+                    center, local, opt_state, rng = carry
+                e, wi = g // wpe, g % wpe
+                # the epoch's rng stream starts at its first window
+                # and is CARRIED through the rest (and across chunk
+                # boundaries via the checkpointed rng), so a
+                # mid-epoch resume replays the identical stream
+                fresh = tree_pvary(jax.random.fold_in(
+                    jax.random.fold_in(key, e), widx))
+                rng = jnp.where(wi == 0, fresh, rng)
+                (local, opt_state, rng), losses = jax.lax.scan(
+                    step, (local, opt_state, rng), (xw, yw))
+                if overlap:
+                    # deferred merge: commit this window's delta, apply
+                    # the PREVIOUS window's summed commit, hand the new
+                    # psum to the next boundary.  Integer leaves (Keras
+                    # seed-generator counters) are RNG state, not
+                    # weights: exempt everywhere, like the blocked path.
+                    delta = self.commit(center, local)
+                    center = tree_merge_floats(
+                        tree_add(center, pending), center)
+                    local = tree_merge_floats(
+                        self.absorb(center, local, delta), local)
+                    local = tree_pvary(local)
+                    pending = tree_merge_floats(tree_psum(delta),
+                                                pending)
+                    return (center, pending, local, opt_state,
+                            rng), losses
+                new_center, new_local = merge(center, local)
+                # integer leaves (Keras seed-generator counters) are
+                # RNG state, not weights: exempt from merge algebra
+                center = tree_merge_floats(new_center, center)
+                local = tree_merge_floats(new_local, local)
+                # merges that reset local to the (replicated) center
+                # must hand back a varying-typed local for next window
+                local = tree_pvary(local)
+                return (center, local, opt_state, rng), losses
+
+            def body(*args):
+                if overlap:
+                    (center, pending, local, opt_state, rng, xs, ys,
+                     key, g0) = args
+                else:
+                    center, local, opt_state, rng, xs, ys, key, g0 = args
                 xs, ys = xs[0], ys[0]  # (wpe | K, W, batch, ...)
                 widx = jax.lax.axis_index(WORKER_AXIS)
                 # carry state arrives stacked (1, ...) per worker shard
@@ -181,32 +290,11 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 opt_state = jax.tree.map(lambda t: t[0], opt_state)
                 rng = rng[0]
 
-                def window(carry, g, xw, yw):
-                    center, local, opt_state, rng = carry
-                    e, wi = g // wpe, g % wpe
-                    # the epoch's rng stream starts at its first window
-                    # and is CARRIED through the rest (and across chunk
-                    # boundaries via the checkpointed rng), so a
-                    # mid-epoch resume replays the identical stream
-                    fresh = tree_pvary(jax.random.fold_in(
-                        jax.random.fold_in(key, e), widx))
-                    rng = jnp.where(wi == 0, fresh, rng)
-                    (local, opt_state, rng), losses = jax.lax.scan(
-                        step, (local, opt_state, rng), (xw, yw))
-                    new_center, new_local = merge(center, local)
-                    # integer leaves (Keras seed-generator counters) are
-                    # RNG state, not weights: exempt from merge algebra
-                    center = tree_merge_floats(new_center, center)
-                    local = tree_merge_floats(new_local, local)
-                    # merges that reset local to the (replicated) center
-                    # must hand back a varying-typed local for next window
-                    local = tree_pvary(local)
-                    return (center, local, opt_state, rng), losses
-
-                carry = (center, local, opt_state, rng)
+                carry = ((center, pending, local, opt_state, rng)
+                         if overlap else (center, local, opt_state, rng))
                 if streamed:
                     carry, losses = jax.lax.scan(
-                        lambda c, inp: window(c, *inp), carry,
+                        lambda c, inp: window(c, *inp, widx, key), carry,
                         (jnp.arange(K) + g0, xs, ys))
                 else:
                     def indexed(c, g):
@@ -215,22 +303,28 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                             xs, wi, 0, keepdims=False)
                         yw = jax.lax.dynamic_index_in_dim(
                             ys, wi, 0, keepdims=False)
-                        return window(c, g, xw, yw)
+                        return window(c, g, xw, yw, widx, key)
 
                     carry, losses = jax.lax.scan(
                         indexed, carry, jnp.arange(K) + g0)
-                center, local, opt_state, rng = carry
                 stack = lambda t: t[None]  # noqa: E731
+                if overlap:
+                    center, pending, local, opt_state, rng = carry
+                    return (center, pending, jax.tree.map(stack, local),
+                            jax.tree.map(stack, opt_state), rng[None],
+                            losses[None])
+                center, local, opt_state, rng = carry
                 return (center, jax.tree.map(stack, local),
                         jax.tree.map(stack, opt_state), rng[None],
                         losses[None])  # losses: (1, K, W)
 
+            rep = (P(),) if overlap else ()  # pending: replicated
             return jax.jit(shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                in_specs=(P(), *rep, P(WORKER_AXIS), P(WORKER_AXIS),
                           P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
                           P(), P()),
-                out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS),
+                out_specs=(P(), *rep, P(WORKER_AXIS), P(WORKER_AXIS),
                            P(WORKER_AXIS), P(WORKER_AXIS)),
             ))
 
@@ -239,15 +333,22 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         local = self._stack_workers(center)
         opt_state = self._stack_workers(opt_init(center))
         rng = self._stack_workers(jnp.zeros((2,), jnp.uint32))
+        # the overlap carry: the previous window's psum'd commit, not
+        # yet applied (zeros before the first boundary — nothing is in
+        # flight at window 0)
+        pending = tree_zeros_like(center) if overlap else None
         template = {"center": center, "local": local,
                     "opt_state": opt_state, "rng": rng}
+        if overlap:
+            template["pending"] = pending
         start_w, restored = self._maybe_resume(
             template,
             incompatible_hint=(
                 "if this checkpoint predates window-granular training "
                 "state (round 2: no 'rng' leaf, step counted epochs not "
                 "windows), restart training or point checkpoint_dir at "
-                "a fresh directory"))
+                "a fresh directory; if it carries a 'pending' leaf the "
+                "run was overlapped — resume with DK_COMM_OVERLAP=1"))
         if restored is not None:
             if "rng" not in restored:
                 raise ValueError(
@@ -255,26 +356,52 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     "(no 'rng' leaf; its step counts epochs, not "
                     "windows) — restart training or point "
                     "checkpoint_dir at a fresh directory")
+            if "pending" in restored and not overlap:
+                raise ValueError(
+                    "checkpoint carries an in-flight overlapped window "
+                    "commit (a 'pending' leaf: it was written under "
+                    "DK_COMM_OVERLAP=1) — resume with DK_COMM_OVERLAP=1 "
+                    "so the commit lands, or restart from a fresh "
+                    "checkpoint_dir")
             center = restored["center"]
             local = restored["local"]
             opt_state = restored["opt_state"]
             rng = restored["rng"]
+            if overlap:
+                # a blocked-era checkpoint resumes into an overlapped
+                # run with nothing in flight — semantically the run's
+                # first boundary simply applies a zero commit
+                pending = restored.get("pending", pending)
 
         key = jax.random.PRNGKey(self.seed)
 
         def dispatch(i, K, windows_done, data):
-            nonlocal center, local, opt_state, rng
+            nonlocal center, pending, local, opt_state, rng
             if self._streamed:
                 fn = self._compiled(lambda: build_chunk(K, streamed=True),
                                     extra_key=("stream", K, wpe))
             else:
                 fn = self._compiled(lambda: build_chunk(K),
                                     extra_key=(K, wpe))
-            center, local, opt_state, rng, losses = fn(
-                center, local, opt_state, rng, *data, key,
-                jnp.int32(windows_done))
+            if overlap:
+                center, pending, local, opt_state, rng, losses = fn(
+                    center, pending, local, opt_state, rng, *data, key,
+                    jnp.int32(windows_done))
+            else:
+                center, local, opt_state, rng, losses = fn(
+                    center, local, opt_state, rng, *data, key,
+                    jnp.int32(windows_done))
             return losses
 
+        def state_fn():
+            state = {"center": center, "local": local,
+                     "opt_state": opt_state, "rng": rng}
+            if overlap:
+                state["pending"] = pending
+            return state
+
+        carry_leaves = ((center, pending, local, opt_state, rng)
+                        if overlap else (center, local, opt_state, rng))
         # history entries are (workers, K, W) per chunk; run_chunked
         # reshapes whole-epoch runs to the round-2 get_history contract
         # (workers, epochs, windows, W) — a run RESUMED mid-epoch stays
@@ -285,10 +412,22 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             cadence=self._ckpt_cadence_windows(wpe),
             samples_per_unit=self.num_workers * W * self.batch_size,
             dispatch=dispatch, sync_ref=lambda: center,
-            state_fn=lambda: {"center": center, "local": local,
-                              "opt_state": opt_state, "rng": rng},
-            carry_leaves=(center, local, opt_state, rng),
+            state_fn=state_fn,
+            carry_leaves=carry_leaves,
             fetch_global=comm.fetch_global)
+        if overlap:
+            # flush the LAST window's in-flight commit so the returned
+            # center includes every worker's final delta — the host-
+            # level half of the double buffer (AsyncMerge: async submit,
+            # deferred block_until_ready; here the wait is immediate
+            # because training is over, but the enqueue/blocking walls
+            # still land in the comm_overlap/comm_blocked split)
+            flush = AsyncMerge(
+                lambda c, p: tree_merge_floats(tree_add(c, p), c))
+            # dklint: ignore[unbounded-wait] block_until_ready on the
+            # just-dispatched flush (an XLA program, which terminates),
+            # not a thread/event wait
+            center = flush.submit(center, pending).wait()
         return self._finalize(center, history)
 
 
@@ -303,6 +442,14 @@ class DOWNPOUR(AsynchronousDistributedTrainer):
         delta = tree_sub(local, center)
         center = tree_add(center, tree_psum(delta))
         return center, center
+
+    def commit(self, center, local):
+        return tree_sub(local, center)
+
+    def absorb(self, center, local, delta):
+        # DOWNPOUR pulls the center after its commit; overlapped, the
+        # pulled center is one window stale (the commit is in flight)
+        return center
 
 
 class ADAG(AsynchronousDistributedTrainer):
@@ -319,6 +466,13 @@ class ADAG(AsynchronousDistributedTrainer):
                            1.0 / self.communication_window)
         center = tree_add(center, tree_psum(delta))
         return center, center
+
+    def commit(self, center, local):
+        return tree_scale(tree_sub(local, center),
+                          1.0 / self.communication_window)
+
+    def absorb(self, center, local, delta):
+        return center
 
 
 class AEASGD(AsynchronousDistributedTrainer):
@@ -341,6 +495,16 @@ class AEASGD(AsynchronousDistributedTrainer):
         local = tree_sub(local, elastic)
         center = tree_add(center, tree_psum(elastic))
         return center, local
+
+    def commit(self, center, local):
+        alpha = self.learning_rate * self.rho
+        return tree_scale(tree_sub(local, center), alpha)
+
+    def absorb(self, center, local, delta):
+        # the elastic force moves the worker toward the center it
+        # MEASURED against (one window stale under overlap); the
+        # worker keeps its own replica, unlike the pull-based family
+        return tree_sub(local, delta)
 
 
 class EAMSGD(AEASGD):

@@ -209,6 +209,32 @@ _register("DK_ALERT_CMD", None, str,
 _register("DK_ALERT_CMD_TIMEOUT_S", 10.0, float, kind="seconds",
           doc="webhook command timeout")
 
+# speed push (round 19)
+_register("DK_COMM_OVERLAP", False, _parse_bool, kind="bool",
+          doc="`1` overlaps the windowed trainers' boundary collective "
+              "with the next window's local compute: each window's "
+              "summed delta is applied ONE window late (the paper's "
+              "async one-window-stale center), so the `psum` has no "
+              "consumer until the following boundary and executes "
+              "concurrently with window k+1's steps.  Off (default) = "
+              "bit-identical to the blocked merge")
+_register("DK_FUSED_BWD", False, _parse_bool, kind="bool",
+          doc="`1` routes `flash_attention`'s backward through the "
+              "single-pass fused kernel — but only after a cached "
+              "per-(shape, blocking, compiler) `selfcheck()` parity "
+              "run against the two-kernel reference passes EXACT in "
+              "this process; mismatch or an unverifiable backend "
+              "falls back to the reference backward with a "
+              "`fused_bwd_rejected` event, never silent corruption")
+_register("DK_PS_COMPRESS", None, str,
+          "PS commit-delta compression spec: `fp16` or `int8`, with "
+          "an optional `@<topk_fraction>`, e.g. `int8@0.1` — the worker "
+          "quantizes (and optionally top-k-sparsifies) each window "
+          "delta before the commit RPC, keeps the compression error "
+          "as a client-side residual folded into the next window "
+          "(error feedback), and the server dequantizes to float32 "
+          "BEFORE DynSGD scaling; unset = full float32 deltas")
+
 # serving
 _register("DK_SERVE_PORT", None, int, kind="port",
           doc="the port a launched serving job binds (exported per "

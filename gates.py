@@ -111,11 +111,24 @@ over HTTP, its local checkpoint directory is deleted outright, and a
 brand-new world-1 host must reshard-restore bit-equal PURELY from the
 remote tier — the spot-fleet replacement-host story, end to end.
 
+The SPEED gate (``--speed-only``, round 19) is the comms speed-layer
+acceptance: the ``DK_COMM_OVERLAP=1`` fused run must be bit-equal to a
+per-window-dispatched run that blocks at every boundary (same
+one-window staleness algebra — "loss-curve-equal to the blocked run
+with staleness accounted") with defaults-off bit-identity and the
+accuracy floor under overlap; the ``DK_FUSED_BWD`` selfcheck verdict
+machinery end to end on CPU (un-interpreted = typed unverifiable,
+interpret-mode parity DETECTS the known multi-kv-block corruption and
+GRADUATES the single-kv-block shape, grads always equal the reference,
+``fused_bwd_rejected`` emitted on fallback); and a 2-worker
+``DK_PS_COMPRESS=int8`` error-feedback run against a live PS server
+holding the pinned DynSGD floor at >= 2x commit-byte reduction.
+
 Usage:  python gates.py [--fast] [--round N] [--out PATH]
                         [--coordination-only] [--obs-only]
                         [--serving-only] [--chaos-only]
                         [--diff-ckpt-only] [--elastic-only]
-                        [--ps-only]
+                        [--ps-only] [--speed-only]
 """
 
 from __future__ import annotations
@@ -2763,6 +2776,325 @@ def run_ps_gate(k_chaos=4, timeout=240):
     }
 
 
+# --- the speed gate (--speed-only, round 19) ---------------------------
+# Three workers, one per tentpole leg of the speed push:
+# (a) overlap: the DK_COMM_OVERLAP=1 fused run must be bit-equal to a
+#     per-window-dispatched run that BLOCKS at every boundary (same
+#     one-window staleness algebra, fully blocked execution) — the
+#     "loss-curve-equal to the blocked run with staleness accounted"
+#     acceptance — plus defaults-off bit-identity and the 0.80 accuracy
+#     floor under overlap;
+# (b) fused backward: the selfcheck verdict machinery end to end on
+#     CPU — un-interpreted parity is typed "unverifiable" (the flag
+#     degrades), interpret-mode parity DETECTS the known multi-kv-block
+#     corruption (the guard demonstrably catches what it exists for),
+#     a single-kv-block interpret shape graduates exact and serves the
+#     fused kernel, and DK_FUSED_BWD=1 grads always match the
+#     reference with a fused_bwd_rejected event on the fallback path;
+# (c) compressed PS: a 2-worker int8+error-feedback run against a live
+#     server holds the pinned DynSGD accuracy floor with >= 2x commit
+#     byte reduction.
+_SPEED_OVERLAP_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, %REPO%)
+from dist_keras_tpu.data import (AccuracyEvaluator, Dataset,
+                                 LabelIndexTransformer, ModelPredictor)
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.trainers import DOWNPOUR
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+n, d = 512, 8
+y = rng.integers(0, 2, size=n)
+centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+x = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+ds = Dataset({"features": x, "label": y, "label_encoded": one_hot(y, 2)})
+kw = dict(num_workers=2, communication_window=4, batch_size=16,
+          label_col="label_encoded", worker_optimizer="sgd",
+          optimizer_kwargs={"learning_rate": 0.05}, seed=0)
+
+
+def run(num_epoch=2, **extra):
+    t = DOWNPOUR(mnist_mlp(hidden=(16,), input_dim=8, num_classes=2,
+                           seed=0), num_epoch=num_epoch, **kw, **extra)
+    m = t.train(ds)
+    return ([np.asarray(w) for w in m.get_weights()],
+            np.asarray(t.get_history()), m)
+
+
+def same(wa, wb):
+    return all(np.array_equal(a, b) for a, b in zip(wa, wb))
+
+
+bad = []
+# (1) defaults bit-identical: unset env == explicit comm_overlap=False
+assert "DK_COMM_OVERLAP" not in os.environ
+w_env, h_env, _ = run()
+w_off, h_off, _ = run(comm_overlap=False)
+if not (same(w_env, w_off) and np.array_equal(h_env, h_off)):
+    bad.append("DK_COMM_OVERLAP unset is not bit-identical to =0")
+# (2) overlapped (one fused dispatch, collectives in flight) ==
+#     blocked (per-window dispatch, depth-bounded drain at every
+#     boundary) under the same one-window staleness algebra
+w_ovl, h_ovl, _ = run(comm_overlap=True)
+w_blk, h_blk, _ = run(comm_overlap=True, stream_chunk_windows=1)
+if not same(w_ovl, w_blk):
+    bad.append("overlapped fused weights != blocked per-window weights")
+if not np.array_equal(h_ovl.reshape(-1), h_blk.reshape(-1)):
+    bad.append("overlapped loss curve != blocked loss curve")
+# the staleness must actually be IN the algebra (not silently off)
+if same(w_ovl, w_off) and np.array_equal(h_ovl, h_off):
+    bad.append("overlap run identical to blocked-merge run — the "
+               "one-window staleness is not being applied")
+# (3) accuracy floor under overlap
+_, _, model = run(num_epoch=4, comm_overlap=True)
+pred = ModelPredictor(model, features_col="features").predict(ds)
+idx = LabelIndexTransformer(input_col="prediction").transform(pred)
+acc = float(AccuracyEvaluator(prediction_col="prediction_index",
+                              label_col="label").evaluate(idx))
+if acc < %FLOOR%:
+    bad.append(f"overlapped DOWNPOUR accuracy {acc:.4f} below the "
+               f"pinned floor %FLOOR%")
+print("SPEED_OVERLAP " + json.dumps(
+    {"ok": not bad, "bad": bad, "accuracy": round(acc, 4)}), flush=True)
+sys.exit(0 if not bad else 1)
+"""
+
+_SPEED_FUSED_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+obs_dir = sys.argv[1]
+os.environ["DK_OBS_DIR"] = obs_dir
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+sys.path.insert(0, %REPO%)
+from dist_keras_tpu.ops.attention import attention
+from dist_keras_tpu.ops.pallas import fused_bwd_experimental as fused
+from dist_keras_tpu.ops.pallas.flash_attention import flash_attention
+
+bad = []
+# (1) un-interpreted parity off-TPU: typed "unverifiable", never a crash
+v = fused.selfcheck(bh=1, t=16, d=8, block_q=8, block_k=8)
+ok, err = v  # the round-5 pair still unpacks
+if v.status != "unverifiable" or ok or err is not None:
+    bad.append(f"CPU selfcheck verdict {v.status!r}, wanted "
+               "unverifiable")
+# (2) interpret-mode parity DETECTS the known multi-kv-block
+#     corruption (the aliased revisit is last-write-wins when
+#     interpreted) — the guard catches exactly what it exists for
+v2 = fused.selfcheck(bh=1, t=16, d=8, block_q=8, block_k=8,
+                     dtype=jnp.float32, interpret=True)
+if v2.status != "mismatch" or v2.err is None or v2.err < 1e-3:
+    bad.append(f"interpret 2-kv-block selfcheck {v2.status!r} "
+               f"err={v2.err} — corruption NOT detected")
+# (3) single-kv-block interpret shape: no revisit, parity is exact
+v3 = fused.selfcheck(bh=1, t=16, d=8, block_q=8, block_k=16,
+                     dtype=jnp.float32, interpret=True)
+if v3.status != "exact":
+    bad.append(f"interpret 1-kv-block selfcheck {v3.status!r}, "
+               "wanted exact")
+# (4) DK_FUSED_BWD=1 routing: the 2-kv-block shape REJECTS (typed
+#     fallback, grads == reference, fused_bwd_rejected emitted); the
+#     1-kv-block shape GRADUATES (fused serves, grads == reference)
+os.environ["DK_FUSED_BWD"] = "1"
+fused.clear_verdicts()
+rng = np.random.default_rng(0)
+q, k, v_ = [jnp.asarray(rng.normal(size=(1, 16, 1, 8))
+                        .astype(np.float32)) for _ in range(3)]
+ref = jax.grad(lambda a, b, c: jnp.sum(attention(a, b, c) ** 2),
+               argnums=(0, 1, 2))(q, k, v_)
+
+
+def flash_grads(block_k):
+    return jax.grad(
+        lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, block_q=8, block_k=block_k,
+            interpret=True) ** 2), argnums=(0, 1, 2))(q, k, v_)
+
+
+for block_k, label in ((8, "fallback (2 kv blocks)"),
+                       (16, "graduated (1 kv block)")):
+    got = flash_grads(block_k)
+    if not all(np.allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                           rtol=1e-3) for a, b in zip(got, ref)):
+        bad.append(f"{label}: grads diverged from the reference")
+verdicts = sorted(vv.status for vv in fused._VERDICTS.values())
+if verdicts != ["exact", "mismatch"]:
+    bad.append(f"verdict cache {verdicts}, wanted one mismatch + one "
+               "exact")
+from dist_keras_tpu.observability import events
+events.reset()
+rejected = []
+for name in sorted(os.listdir(obs_dir)):
+    if name.startswith("events-"):
+        with open(os.path.join(obs_dir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "fused_bwd_rejected":
+                    rejected.append(rec.get("reason"))
+if "mismatch" not in rejected:
+    bad.append(f"no mismatch fused_bwd_rejected event ({rejected})")
+print("SPEED_FUSED " + json.dumps(
+    {"ok": not bad, "bad": bad, "rejected_events": rejected,
+     "mismatch_err": v2.err}), flush=True)
+sys.exit(0 if not bad else 1)
+"""
+
+_SPEED_PS_WORKER = r"""
+import json, os, sys, threading
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DK_PS_COMPRESS"] = "int8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, %REPO%)
+from dist_keras_tpu.data import (AccuracyEvaluator, Dataset,
+                                 LabelIndexTransformer, ModelPredictor)
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.ps import PSServer, PSWorkerTrainer
+from dist_keras_tpu.utils.misc import one_hot
+
+rng = np.random.default_rng(0)
+n, d = 512, 8
+y = rng.integers(0, 2, size=n)
+centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+x = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+ds = Dataset({"features": x, "label": y, "label_encoded": one_hot(y, 2)})
+srv = PSServer(params=mnist_mlp(hidden=(16,), input_dim=8,
+                                num_classes=2, seed=0).params,
+               port=0, window=4)
+srv.start()
+addr = srv.address[0] + ":" + str(srv.address[1])
+trainers, errors = [], []
+
+
+def work(seed):
+    t = PSWorkerTrainer(
+        mnist_mlp(hidden=(16,), input_dim=8, num_classes=2, seed=0),
+        server_addr=addr, communication_window=4,
+        worker_optimizer="sgd", optimizer_kwargs={"learning_rate": 0.05},
+        batch_size=16, num_epoch=6, label_col="label_encoded",
+        seed=seed)
+    trainers.append(t)
+    try:
+        t.train(ds)
+    except Exception as e:  # noqa: BLE001 - reported, fails the gate
+        errors.append(f"worker seed {seed}: {type(e).__name__}: {e}")
+
+
+threads = [threading.Thread(target=work, args=(s,)) for s in (1, 2)]
+for th in threads:
+    th.start()
+for th in threads:
+    th.join(300)
+bad = list(errors)
+staleness = [s for t in trainers for (_, s, _) in t.commit_log]
+if not any(s > 0 for s in staleness):
+    bad.append("no commit saw staleness > 0 — two workers never "
+               "actually interleaved")
+raw = sum(t.commit_bytes["raw"] for t in trainers)
+wire = sum(t.commit_bytes["wire"] for t in trainers)
+ratio = raw / wire if wire else 0.0
+if ratio < 2.0:
+    bad.append(f"int8 commit-byte reduction {ratio:.2f}x < 2x")
+# the CENTER is the authoritative result (a finisher's local replica
+# legitimately misses the other's last commits)
+clock, center = srv.center.state()
+model = mnist_mlp(hidden=(16,), input_dim=8, num_classes=2, seed=0)
+model.set_params(center)
+pred = ModelPredictor(model, features_col="features").predict(ds)
+idx = LabelIndexTransformer(input_col="prediction").transform(pred)
+acc = float(AccuracyEvaluator(prediction_col="prediction_index",
+                              label_col="label").evaluate(idx))
+if acc < %FLOOR%:
+    bad.append(f"compressed-PS center accuracy {acc:.4f} below the "
+               f"pinned DynSGD floor %FLOOR%")
+srv.close()
+print("SPEED_PS " + json.dumps(
+    {"ok": not bad, "bad": bad, "accuracy": round(acc, 4),
+     "bytes_ratio": round(ratio, 2), "clock": clock,
+     "max_staleness": max(staleness) if staleness else None}),
+    flush=True)
+sys.exit(0 if not bad else 1)
+"""
+
+
+def run_speed_gate(timeout=300):
+    """-> gate record for the round-19 speed push: overlapped window
+    collectives (blocked-vs-overlapped bit-equality + staleness
+    actually applied + accuracy floor), fused-backward graduation
+    (selfcheck verdicts + typed fallback + graduation, interpret-mode
+    parity on CPU), and compressed PS deltas (2-worker int8 run holds
+    the DynSGD floor at >= 2x byte reduction)."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_speed_gate_")
+    t0 = time.time()
+    failures = []
+    detail = {}
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_", "JAX_PLATFORMS"))
+                and k != "XLA_FLAGS"}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    floor = str(_PS_ACC_FLOOR)
+    workers = (
+        ("overlap", "SPEED_OVERLAP", _SPEED_OVERLAP_WORKER, ()),
+        ("fused_bwd", "SPEED_FUSED", _SPEED_FUSED_WORKER,
+         (os.path.join(work, "obs"),)),
+        ("ps_compress", "SPEED_PS", _SPEED_PS_WORKER, ()),
+    )
+    try:
+        os.makedirs(os.path.join(work, "obs"), exist_ok=True)
+        for name, marker, source, args in workers:
+            script = os.path.join(work, f"{name}.py")
+            with open(script, "w") as f:
+                f.write(source.replace("%REPO%", repr(REPO))
+                        .replace("%FLOOR%", floor))
+            try:
+                proc = subprocess.run(
+                    [sys.executable, script, *args],
+                    capture_output=True, text=True, env=dict(base_env),
+                    timeout=timeout)
+            except subprocess.TimeoutExpired:
+                failures.append(f"{name}: HANG (killed at {timeout}s)")
+                continue
+            m = re.search(rf"^{marker} (\{{.*\}})$", proc.stdout, re.M)
+            if m:
+                detail[name] = json.loads(m.group(1))
+            if proc.returncode != 0 or not m:
+                tail = (proc.stdout + proc.stderr).strip()[-400:]
+                failures.append(
+                    f"{name}: rc={proc.returncode}: "
+                    + "; ".join(detail.get(name, {}).get("bad", []))
+                    + (f" [{tail}]" if not m else ""))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "speed_push",
+        "metric": "overlap_bit_equal_fused_guarded_ps_compressed",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "accuracy_floor": _PS_ACC_FLOOR,
+        "detail": detail,
+        "failures": failures,
+    }
+
+
 def run_gates(fast=False, timeout=3 * 3600):
     cmd = [sys.executable, "-m", "pytest", "tests/test_examples.py",
            "-q", "-s", "-p", "no:cacheprovider"]
@@ -2833,6 +3165,14 @@ def main():
                          "wiped-local-disk host restoring purely "
                          "from the remote store) and print its "
                          "record")
+    ap.add_argument("--speed-only", action="store_true",
+                    help="run just the speed-push gate (overlapped "
+                         "window collectives bit-equal to the blocked "
+                         "staleness-accounted run, fused-backward "
+                         "selfcheck graduation incl. interpret-mode "
+                         "corruption detection, compressed-PS 2-worker "
+                         "accuracy floor at >=2x byte reduction) and "
+                         "print its record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -2845,6 +3185,11 @@ def main():
         lint_gate = run_lint_gate()
         print(json.dumps(lint_gate, indent=1))
         return 0 if lint_gate["passed"] else 1
+
+    if args.speed_only:
+        speed_gate = run_speed_gate()
+        print(json.dumps(speed_gate, indent=1))
+        return 0 if speed_gate["passed"] else 1
 
     if args.watchdog_only:
         wd_gate = run_watchdog_gate()
@@ -2894,6 +3239,7 @@ def main():
     res["gates"].append(run_diff_ckpt_gate())
     res["gates"].append(run_elastic_gate())
     res["gates"].append(run_ps_gate())
+    res["gates"].append(run_speed_gate())
     res["gates"].append(run_watchdog_gate())
     res["gates"].append(run_lint_gate())
     import platform
