@@ -1242,6 +1242,44 @@ def bench_ps_compress(peak=None, mb=8, reps=5, timeout_s=300):
         timeout_s=timeout_s)
 
 
+def bench_sim_swarm(peak=None, hosts=1000, timeout_s=300):
+    """Deterministic cluster simulator throughput (``sim_swarm``): the
+    1000-host PS-churn chaos scenario from ``dist_keras_tpu.sim``, run
+    to completion in a CPU-pinned subprocess.  What gets measured is
+    the simulator itself — wall seconds to execute thousands of
+    simulated host-steps plus kill/reap/rejoin/partition chaos in
+    simulated time — so the row tracks whether the sim stays fast
+    enough to live inside gates and CI (acceptance: well under 60s
+    wall).  No ``vs_baseline`` (the reference has no simulator)."""
+    rec = _run_cpu_worker(
+        "sim_swarm",
+        argv=["-m", "dist_keras_tpu.sim", "--scenario", "ps_churn",
+              "--seed", "0", "--hosts", str(hosts)],
+        strip_prefixes=("DK_SIM", "DK_PS"),
+        timeout_s=timeout_s)
+    if "error" in rec:
+        return rec
+    # flatten the CLI's {"scenarios": [...]} doc into one bench row
+    s = (rec.get("scenarios") or [{}])[0]
+    return {
+        "name": "sim_swarm",
+        "platform": "cpu",
+        "hosts": s.get("hosts"),
+        "commits": s.get("commits"),
+        "typed_faults": s.get("typed_faults"),
+        "killed": s.get("killed"),
+        "accuracy": s.get("accuracy"),
+        "sim_elapsed_s": s.get("sim_elapsed_s"),
+        "wall_s": s.get("wall_s"),
+        "host_steps_per_wall_s": (
+            round(s["hosts"] * s["steps_per_host"] / s["wall_s"], 1)
+            if s.get("wall_s") else None),
+        "digest": (s.get("digest") or "")[:16],
+        "passed": bool(rec.get("passed")),
+        "vs_baseline": None,
+    }
+
+
 def _backend_responsive(timeout_s=180):
     """Probe the default backend in a SUBPROCESS with a hard timeout.
 
@@ -1401,7 +1439,9 @@ def main():
                                   (bench_comm_overlap,
                                    "comm_overlap"),
                                   (bench_ps_compress,
-                                   "ps_compress")):
+                                   "ps_compress"),
+                                  (bench_sim_swarm,
+                                   "sim_swarm")):
             t0 = time.time()
             _obs_emit("bench_config_begin", name=fn.__name__)
             try:
@@ -1433,6 +1473,7 @@ def main():
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,
                bench_comm_overlap, bench_ps_compress,
+               bench_sim_swarm,
                bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:

@@ -74,8 +74,8 @@ import os
 import re
 import shlex
 import subprocess
-import time
 
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.retry import RetryPolicy
 
@@ -114,7 +114,7 @@ class Job:
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
                  serve_port=None, supervise=None, metrics_port=None,
                  obs_sample_s=None, trace_id=None, ps_addr=None,
-                 ps_window=None):
+                 ps_window=None, runner=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -275,12 +275,24 @@ class Job:
                 "budget_window_s": 600.0,
                 "interval_s": 10.0, "grace_s": 30.0,
                 "elastic": None, "min_world": None}
+        # runner: the process spawn/kill seam.  A callable
+        # ``runner(cmd) -> rc`` replaces subprocess.call for every
+        # per-host command (rsync/ssh/launch/stop) — the cluster
+        # simulator injects one that manipulates local rc/hb files
+        # instead of reaching for a shell, so supervise_run's relaunch
+        # waves run against simulated hosts.  None = real subprocess.
+        self.runner = runner
         self.commands = []  # record of everything (to be) executed
 
     # -- internals -----------------------------------------------------
     def _run(self, cmd, point=None):
         self.commands.append(cmd)
-        rc = 0 if self.dry_run else subprocess.call(cmd)
+        if self.dry_run:
+            rc = 0
+        elif self.runner is not None:
+            rc = int(self.runner(cmd))
+        else:
+            rc = subprocess.call(cmd)
         if point is not None:
             # fault hook: a replace-fault forges the return code, so a
             # flaky transport is simulated without a cluster
@@ -474,7 +486,7 @@ class Job:
                     prev_ranks = {k: dict(v) for k, v in ranks.items()}
                 polls += 1
                 if max_polls is None or polls < max_polls:
-                    time.sleep(float(interval_s))
+                    _world.sleep(float(interval_s))
         except KeyboardInterrupt:  # pragma: no cover - operator ^C
             pass
         return transitions
@@ -725,7 +737,9 @@ class Job:
         polls = 0
         try:
             while max_polls is None or polls < max_polls:
-                now = time.monotonic()
+                # world seam: wave grace windows and poll cadence run
+                # on simulated time under the cluster simulator
+                now = _world.monotonic()
                 # the fresh incarnation needs grace_s before its first
                 # heartbeats can exist — judging the new session's
                 # empty directory immediately would read as all-dead
@@ -867,13 +881,13 @@ class Job:
                     # grace runs from wave END: a slow multi-host
                     # rsync must not eat the new incarnation's
                     # startup window
-                    last_wave = time.monotonic()
+                    last_wave = _world.monotonic()
                     if rc != 0 and out is not None:
                         out(f"[supervise] relaunch wave {session} "
                             f"returned rc={rc}; next poll retries")
                 polls += 1
                 if max_polls is None or polls < max_polls:
-                    time.sleep(interval_s)
+                    _world.sleep(interval_s)
         except KeyboardInterrupt:  # pragma: no cover - operator ^C
             pass
         return relaunched
@@ -956,5 +970,5 @@ class Punchcard:
                 ran.extend(launched)
             polls += 1
             if max_polls is None or polls < max_polls:
-                time.sleep(self.poll_interval)
+                _world.sleep(self.poll_interval)
         return ran or []

@@ -96,6 +96,7 @@ KNOWN_EVENTS = (
     # differential + remote checkpoint tier (checkpoint.py,
     # resilience/store.py)
     "ckpt_diff", "ckpt_gc", "ckpt_push", "ckpt_pull",
+    "ckpt_remote_prune",
     # resilience seams
     "retry", "retry_exhausted", "fault", "nonfinite", "nan_halt",
     "preempt_signal", "preempt", "preempt_exit",
@@ -119,6 +120,8 @@ KNOWN_EVENTS = (
     # bench driver (repo-root bench.py)
     "bench_probe_begin", "bench_probe_end", "bench_config_begin",
     "bench_config_end", "bench_config_skipped", "bench_complete",
+    # cluster simulator (sim/)
+    "sim_scenario_begin", "sim_scenario_end",
 )
 
 

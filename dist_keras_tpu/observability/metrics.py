@@ -168,6 +168,7 @@ KNOWN_METRICS = {
     # resilience/store.py)
     "ckpt.chunks_skipped": "counter",
     "ckpt.bytes_pushed": "counter",
+    "ckpt.remote_pruned": "counter",
     # streaming data plane
     "stream.batches": "counter",
     "stream.rows": "counter",
@@ -221,6 +222,9 @@ KNOWN_METRICS = {
     "watchdog.firing.*": "gauge",
     # flight recorder (observability/flight.py)
     "flight.dumps": "counter",
+    # cluster simulator (sim/)
+    "sim.host_steps": "counter",
+    "sim.faults": "counter",
 }
 
 _lock = threading.Lock()

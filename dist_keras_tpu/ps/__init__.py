@@ -8,6 +8,9 @@ mode (:mod:`~dist_keras_tpu.ps.worker`), and the RPC client with named
 retry surfaces + chaos fault points (:mod:`~dist_keras_tpu.ps.client`).
 Server-side DynSGD scaling lives in :mod:`~dist_keras_tpu.ps.center`,
 bit-parity-tested against ``trainers/dynsgd.py``.
+:mod:`~dist_keras_tpu.ps.inproc` is the same protocol over direct
+method calls — the cluster simulator's socket-free transport (round
+20), with the handler's verdicts, metrics, and events intact.
 
 ``PSWorkerTrainer`` is PEP-562 lazy: the SERVER process (center +
 server + client are numpy/stdlib-light) must not pay the jax + trainer
@@ -19,12 +22,14 @@ from dist_keras_tpu.ps.center import (CenterVariable, PSError,
                                       StaleCommit, apply_commit,
                                       dynsgd_scale)
 from dist_keras_tpu.ps.client import PSClient, PSUnavailable
+from dist_keras_tpu.ps.inproc import InProcPSClient, InProcPSServer
 from dist_keras_tpu.ps.server import PSServer
 
 __all__ = [
     "CenterVariable", "PSError", "StaleCommit",
     "apply_commit", "dynsgd_scale",
     "PSClient", "PSUnavailable", "PSServer", "PSWorkerTrainer",
+    "InProcPSClient", "InProcPSServer",
 ]
 
 

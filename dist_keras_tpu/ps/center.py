@@ -41,10 +41,10 @@ returns, from their own thread).
 from __future__ import annotations
 
 import threading
-import time
 
 import numpy as np
 
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.utils import knobs
 
 
@@ -166,7 +166,7 @@ class CenterVariable:
         center copy, rejoined).  A late joiner pulls-and-goes: the join
         response IS its first pull.  ``wid=None`` mints a fresh id; a
         known wid renews in place (worker restart with a sticky id)."""
-        now = time.monotonic() if now is None else now
+        now = _world.monotonic() if now is None else now
         with self._lock:
             rejoined = wid is not None and wid in self._leases
             if wid is None:
@@ -187,7 +187,7 @@ class CenterVariable:
         """-> (version, center copy); renews the caller's lease when its
         wid is known (an unknown wid still gets the read — pulls are
         read-only and a reader must never be refused the truth)."""
-        now = time.monotonic() if now is None else now
+        now = _world.monotonic() if now is None else now
         with self._lock:
             lease = self._leases.get(wid) if wid else None
             if lease is not None:
@@ -200,7 +200,7 @@ class CenterVariable:
         lapsed worker leaves staleness accounting entirely — the run
         never stalls waiting for it; if it comes back, its next commit
         auto-rejoins (graceful degrade, not a stall)."""
-        now = time.monotonic() if now is None else now
+        now = _world.monotonic() if now is None else now
         with self._lock:
             dead = [w for w in self._leases.values()
                     if w.expires_at <= now]
@@ -259,7 +259,7 @@ class CenterVariable:
         so this is the deliberate bounded trade against remembering
         every dead worker forever.
         """
-        now = time.monotonic() if now is None else now
+        now = _world.monotonic() if now is None else now
         with self._lock:
             lease = self._leases.get(wid)
             if (commit_id is not None and lease is not None

@@ -41,7 +41,6 @@ from __future__ import annotations
 import json
 import pickle
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -49,6 +48,7 @@ import numpy as np
 from dist_keras_tpu.observability import events, spans
 from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.resilience import preemption
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.utils import knobs
 from dist_keras_tpu.utils.serialization import (pickle_object,
                                                 unpickle_object)
@@ -522,16 +522,16 @@ class PSServer(ThreadingHTTPServer):
             # contract): the in-flight wait and the final-save handle
             # wait share it — two stacked full timeouts would double
             # the grace window a scheduler actually grants
-            deadline = time.monotonic() + float(timeout_s)
+            deadline = _world.monotonic() + float(timeout_s)
             # a commit that read draining=False a moment ago may still
             # be applying: the final snapshot must include it (bounded
             # — a wedged handler degrades to draining what is there)
             with self._inflight_cv:
                 self._inflight_cv.wait_for(
                     lambda: self._inflight_commits == 0,
-                    timeout=max(0.0, deadline - time.monotonic()))
+                    timeout=max(0.0, deadline - _world.monotonic()))
             step = self.checkpoint_now(
-                timeout_s=max(0.0, deadline - time.monotonic()))
+                timeout_s=max(0.0, deadline - _world.monotonic()))
             self._reaper_stop.set()
         self._stop_listener()
         return step

@@ -29,13 +29,12 @@ weights), not this worker's local replica.
 
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dist_keras_tpu.observability import metrics as _metrics
+from dist_keras_tpu.resilience import world as _dkworld
 from dist_keras_tpu.trainers.base import Trainer
 from dist_keras_tpu.utils import knobs
 from dist_keras_tpu.ps import compress as _compress
@@ -193,7 +192,9 @@ class PSWorkerTrainer(Trainer):
         history = []
         epoch_losses = []
         t = 0
-        epoch_t0 = time.time()
+        # world seam: epoch wall stamps follow the sim clock under the
+        # cluster simulator (real time.time otherwise)
+        epoch_t0 = _dkworld.time()
         center = joined["center"]
         # delta compression (DK_PS_COMPRESS): the error-feedback
         # residual holds what the codec dropped from the LAST shipped
@@ -251,7 +252,7 @@ class PSWorkerTrainer(Trainer):
                 params = _merge_center(center, params)
                 pulled = _pulled_f32(params)
                 if t % spe == 0:
-                    now = time.time()
+                    now = _dkworld.time()
                     self._emit_epoch_end(
                         t // spe, epoch_losses, now - epoch_t0,
                         len(epoch_losses) * self.batch_size)

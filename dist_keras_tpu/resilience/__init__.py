@@ -50,6 +50,7 @@ from dist_keras_tpu.resilience import (
     preemption,
     retry,
     supervisor,
+    world,
 )
 from dist_keras_tpu.resilience.coordination import (
     BarrierTimeout,
@@ -76,7 +77,7 @@ from dist_keras_tpu.resilience.supervisor import (
 
 __all__ = [
     "coordination", "elastic", "faults", "guards", "preemption",
-    "retry", "supervisor",
+    "retry", "supervisor", "world",
     "BarrierTimeout", "CoordinatorPoisoned", "CrashLoop",
     "FaultInjected", "FileCoordinator", "PeerLost", "RestartBudget",
     "armed", "fault_point", "get_coordinator", "inject",

@@ -52,6 +52,7 @@ import os
 import threading
 import time
 
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.utils import knobs
 
@@ -149,13 +150,15 @@ def wait_for_peers(missing_fn, timeout_s, what, poll_s=0.02,
     heartbeat evidence; that invariant is what lets a supervisor act
     on ``e.ranks`` (exclude/restart the host) without misdiagnosing a
     slow start."""
-    deadline = time.monotonic() + timeout_s
-    next_probe = time.monotonic() + 1.0
+    # the world seam, not time.*: under the cluster simulator these
+    # deadlines and probe cadences are judged on simulated time
+    deadline = _world.monotonic() + timeout_s
+    next_probe = _world.monotonic() + 1.0
     while True:
         missing = missing_fn()
         if not missing:
             return
-        now = time.monotonic()
+        now = _world.monotonic()
         if now >= next_probe or now > deadline:
             next_probe = now + 1.0
             stale = [r for r in (stale_fn() if stale_fn else ())
@@ -169,7 +172,7 @@ def wait_for_peers(missing_fn, timeout_s, what, poll_s=0.02,
                 f"{what} timed out waiting for rank(s) {missing} "
                 f"after {timeout_s}s (no heartbeat evidence of death "
                 "on the missing ranks)")
-        time.sleep(poll_s)
+        _world.sleep(poll_s)
 
 
 # ---------------------------------------------------------------------------
@@ -193,7 +196,7 @@ class Heartbeat:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as f:
-            f.write(repr(time.time()))
+            f.write(repr(_world.time()))
         os.replace(tmp, self.path)
 
     def _loop(self):
@@ -248,7 +251,9 @@ def dead_peers(directory, world, stale_after_s=10.0, ranks=None,
     hb = os.path.join(directory, "hb")
     if not os.path.isdir(hb):
         return []
-    now = time.time()
+    # world seam: a sim scenario stamps hb mtimes with os.utime on the
+    # SIM clock, so staleness judgments replay deterministically
+    now = _world.time()
     dead = []
     for r in (range(world) if ranks is None else ranks):
         try:

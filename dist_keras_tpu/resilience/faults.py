@@ -81,6 +81,7 @@ import os
 import re
 import threading
 
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.utils import knobs
 
 
@@ -132,10 +133,13 @@ KNOWN_POINTS = (
 
 
 class FaultSpec:
-    """One armed fault: fire on calls ``at .. at+times-1`` of a point."""
+    """One armed fault: fire on calls ``at .. at+times-1`` of a point —
+    or, with ``at_s`` set, on the first ``times`` calls at or past that
+    TIME on the world clock (``chaos_schedule(horizon_s=...)``; under
+    the cluster simulator that is simulated seconds)."""
 
     def __init__(self, point, at=0, times=1, action="raise", exc=None,
-                 value=None):
+                 value=None, at_s=None):
         if action not in ("raise", "corrupt", "replace", "delay"):
             raise ValueError(f"unknown fault action {action!r}")
         self.point = str(point)
@@ -144,9 +148,19 @@ class FaultSpec:
         self.action = action
         self.exc = exc or FaultInjected
         self.value = value
+        self.at_s = None if at_s is None else float(at_s)
+        # at_s is RELATIVE seconds; anchor it to the world clock at
+        # arming so "fire past 3.2s" means 3.2s from now (sim seconds
+        # under the cluster simulator, wall seconds in real runs)
+        self._armed_mono = (None if at_s is None
+                            else _world.monotonic())
         self.fired = 0  # introspection: how many times this spec fired
 
     def covers(self, count):
+        if self.at_s is not None:
+            return (self.fired < self.times
+                    and _world.monotonic() - self._armed_mono
+                    >= self.at_s)
         return self.at <= count < self.at + self.times
 
     def __repr__(self):  # pragma: no cover - debug aid
@@ -264,18 +278,26 @@ def _parse_env_entry(entry):
                      value=value)
 
 
-def chaos_schedule(seed, rate=0.25, horizon=20, points=None):
+def chaos_schedule(seed, rate=0.25, horizon=20, points=None,
+                   horizon_s=None):
     """Build (without arming) the seeded chaos schedule: a list of
     :class:`FaultSpec`, one per point that drew a firing.
 
-    A PURE function of ``(seed, rate, horizon, points)``: the PRNG
-    draws the SAME sequence for every point whether or not it arms
-    (fire/at/exc consumed unconditionally), so tightening ``rate``
+    A PURE function of ``(seed, rate, horizon, points, horizon_s)``:
+    the PRNG draws the SAME sequence for every point whether or not it
+    arms (fire/at/exc consumed unconditionally), so tightening ``rate``
     never reshuffles which call index a still-armed point fires at —
     a chaos failure reproduces from its seed alone.  Each armed point
     fires once, at a uniform call index in ``[0, horizon)``, raising
     either a permanent :class:`FaultInjected` (simulated kill) or a
     retryable ``OSError`` (transient to absorb) — seeded coin flip.
+
+    ``horizon_s`` switches the schedule from call counts to TIME: each
+    armed point instead fires on its first call at or past a uniform
+    instant in ``[0, horizon_s)`` seconds on the world clock (sim
+    seconds under the cluster simulator).  The extra per-point draw
+    happens only in this mode, so every pre-existing
+    ``(seed, rate, horizon)`` schedule is preserved verbatim.
     """
     import random as _random
 
@@ -285,15 +307,19 @@ def chaos_schedule(seed, rate=0.25, horizon=20, points=None):
     horizon = int(horizon)
     if horizon < 1:
         raise ValueError(f"chaos horizon={horizon} must be >= 1")
+    if horizon_s is not None and float(horizon_s) <= 0:
+        raise ValueError(f"chaos horizon_s={horizon_s} must be > 0")
     rng = _random.Random(int(seed))
     specs = []
     for point in (KNOWN_POINTS if points is None else tuple(points)):
         fire = rng.random() < rate
         at = rng.randrange(horizon)
         transient = rng.random() < 0.5
+        at_s = (None if horizon_s is None
+                else rng.random() * float(horizon_s))
         if fire:
             specs.append(FaultSpec(
-                point, at=at,
+                point, at=at, at_s=at_s,
                 exc=OSError if transient else FaultInjected))
     return specs
 
@@ -322,6 +348,16 @@ def _load_chaos_env():
     except ValueError:
         raise ValueError(
             f"malformed DK_FAULTS_HORIZON {horizon!r}: expected an int")
+    horizon_s = (knobs.raw("DK_FAULTS_HORIZON_S") or "").strip()
+    if horizon_s:
+        try:
+            horizon_s = float(horizon_s)
+        except ValueError:
+            raise ValueError(
+                f"malformed DK_FAULTS_HORIZON_S {horizon_s!r}: "
+                "expected a float")
+    else:
+        horizon_s = None
     points = None
     raw_points = (knobs.raw("DK_FAULTS_POINTS") or "").strip()
     if raw_points:
@@ -333,7 +369,7 @@ def _load_chaos_env():
                 f"DK_FAULTS_POINTS names unknown fault point(s) "
                 f"{unknown}; known: {sorted(KNOWN_POINTS)}")
     for spec in chaos_schedule(seed, rate=rate, horizon=horizon,
-                               points=points):
+                               points=points, horizon_s=horizon_s):
         _specs.setdefault(spec.point, []).append(spec)
 
 
@@ -408,10 +444,11 @@ def fault_point(name, value=_MISSING):
         # seconds, then pass the value through untouched — the
         # deterministic "this rank got slow" injection the perf
         # watchdog gate drives (a raise would end the run instead of
-        # degrading it)
-        import time
-
-        time.sleep(float(spec.value or 0.0))
+        # degrading it).  Routed through the world seam: under the
+        # cluster simulator the delay advances SIMULATED time instead
+        # of stalling the sim thread; in real runs world.sleep IS
+        # time.sleep, bit-identical behavior
+        _world.sleep(float(spec.value or 0.0))
         return None if value is _MISSING else value
     if spec.action == "replace":
         return spec.value

@@ -20,13 +20,16 @@ Design points:
   original exception type; the attempt count rides on the exception as
   ``_retry_attempts`` for diagnostics.
 - **Injectable clock/sleep** so tests assert the schedule without
-  sleeping.
+  sleeping.  Defaults route through the :mod:`~dist_keras_tpu
+  .resilience.world` seam (resolved per call), so backoff sleeps under
+  the cluster simulator advance simulated time instead of stalling.
 """
 
 from __future__ import annotations
 
 import random
-import time
+
+from dist_keras_tpu.resilience import world as _world
 
 
 class RetryPolicy:
@@ -36,8 +39,8 @@ class RetryPolicy:
 
     def __init__(self, attempts=3, backoff=0.1, multiplier=2.0,
                  max_delay=30.0, jitter=0.0, timeout=None,
-                 retryable=(OSError,), sleep=time.sleep,
-                 clock=time.monotonic, on_retry=None, seed=None,
+                 retryable=(OSError,), sleep=None,
+                 clock=None, on_retry=None, seed=None,
                  name=None):
         if int(attempts) < 1:
             raise ValueError(f"attempts={attempts} must be >= 1")
@@ -52,8 +55,11 @@ class RetryPolicy:
         self.jitter = float(jitter)
         self.timeout = None if timeout is None else float(timeout)
         self.retryable = tuple(retryable)
-        self.sleep = sleep
-        self.clock = clock
+        # None -> the world-seam module functions, which resolve the
+        # CURRENT world at call time: a SimWorld installed after this
+        # policy was built still governs its sleeps and deadlines
+        self.sleep = _world.sleep if sleep is None else sleep
+        self.clock = _world.monotonic if clock is None else clock
         self.on_retry = on_retry
         # name: which retry surface this is ("checkpoint.save",
         # "job.rsync", ...) — stamped on the observability events and
@@ -151,7 +157,7 @@ def retry_call(fn, *args, policy=None, **kwargs):
 
 def retry(fn=None, *, attempts=3, backoff=0.1, multiplier=2.0,
           max_delay=30.0, jitter=0.0, timeout=None, retryable=(OSError,),
-          sleep=time.sleep, on_retry=None, seed=0, name=None):
+          sleep=None, on_retry=None, seed=0, name=None):
     """Decorator form: ``@retry`` or ``@retry(attempts=5, ...)``.
 
     The policy is built once at decoration time; its jitter PRNG is

@@ -13,6 +13,9 @@ module gives checkpoints a pluggable remote home:
   against any object-store-shaped endpoint; :class:`ObjectStoreServer`
   is the matching stdlib server in the ``serving/server.py`` style, so
   tests and gates exercise the real wire path without a cloud bucket).
+  :class:`MemoryStore` (``mem://<name>`` URLs) is the in-process
+  third backend — the cluster simulator's disk/network tier, with a
+  partition hook for unreachable-window scenarios.
 - The MIRROR PROTOCOL (:func:`push_step` / :func:`fetch_step`) maps a
   promoted local step onto store keys: content-addressed chunks under
   ``chunks/<sha256>`` (pushed at most once — the differential CAS
@@ -33,7 +36,12 @@ module gives checkpoints a pluggable remote home:
   ``DK_CKPT_REMOTE`` is set (leader-only on shared-dir pods).  Push
   failures are absorbed typed in the loop (events + retry surface
   counters) and re-tried next poll: a dead store degrades the run to
-  local-only durability, never kills it.
+  local-only durability, never kills it.  Since round 20 the uploader
+  also owns remote RETENTION: after each poll that pushed something,
+  :func:`prune_remote` retires mirrored steps past the
+  ``DK_CKPT_REMOTE_KEEP`` horizon (default: follow the local
+  ``max_to_keep``) — marker-first deletes plus a conservative CAS
+  sweep, counted by ``ckpt.remote_pruned`` / ``ckpt_remote_prune``.
 
 Failure semantics: every object transfer runs under a named
 ``RetryPolicy`` surface (``"ckpt.push"`` / ``"ckpt.pull"``, transient
@@ -167,6 +175,82 @@ class LocalDirStore(CheckpointStore):
             pass  # idempotent: absent is the goal state
 
 
+class MemoryStore(CheckpointStore):
+    """In-process dict backend — the cluster simulator's disk/network
+    tier (and a zero-setup store for tests).  Hundreds of simulated
+    writers share one instance with no sockets and no tmpdirs, while
+    the mirror protocol above it stays byte-identical to production.
+
+    Per-key puts are atomic by construction (one dict assignment under
+    the lock).  ``fail`` is the partition hook: a callable
+    ``fail(op, key) -> bool`` consulted before every operation —
+    returning True raises a transient :class:`StoreError`, which is how
+    a scenario script makes the remote tier unreachable for a window
+    and then heals it."""
+
+    def __init__(self, fail=None):
+        self._objects = {}
+        self._lock = threading.Lock()
+        self.fail = fail
+
+    def _gate(self, op, key):
+        if self.fail is not None and self.fail(op, key):
+            raise StoreError(
+                f"store unreachable (simulated partition): {op} {key!r}")
+
+    def put_bytes(self, key, data):
+        key = _check_key(key)
+        self._gate("put", key)
+        with self._lock:
+            self._objects[key] = bytes(data)
+
+    def get_bytes(self, key):
+        key = _check_key(key)
+        self._gate("get", key)
+        with self._lock:
+            try:
+                return self._objects[key]
+            except KeyError:
+                raise FileNotFoundError(
+                    f"store has no object {key!r}") from None
+
+    def exists(self, key):
+        key = _check_key(key)
+        self._gate("head", key)
+        with self._lock:
+            return key in self._objects
+
+    def list(self, prefix=""):
+        self._gate("list", prefix)
+        with self._lock:
+            return sorted(k for k in self._objects
+                          if k.startswith(prefix))
+
+    def delete(self, key):
+        key = _check_key(key)
+        self._gate("delete", key)
+        with self._lock:
+            self._objects.pop(key, None)
+
+
+# named in-process stores, so `mem://<name>` URLs resolve to a SHARED
+# MemoryStore within the process — the sim scenario and the components
+# it drives (uploader, fetch paths) meet at the same object the way
+# real processes meet at the same bucket
+_memory_stores = {}
+_memory_stores_lock = threading.Lock()
+
+
+def memory_store(name="default"):
+    """The process-wide named :class:`MemoryStore` (created on first
+    use) — what ``mem://<name>`` resolves to."""
+    with _memory_stores_lock:
+        store = _memory_stores.get(str(name))
+        if store is None:
+            store = _memory_stores[str(name)] = MemoryStore()
+        return store
+
+
 class HTTPStore(CheckpointStore):
     """Stdlib ``http.client`` backend against an object-store-shaped
     endpoint (``PUT/GET/HEAD/DELETE /o/<key>`` + ``GET /list?prefix=``
@@ -254,6 +338,10 @@ def store_from_url(url):
     url = str(url).strip()
     if url.startswith("http://"):
         return HTTPStore(url)
+    if url.startswith("mem://"):
+        # in-process named store (cluster simulator / tests): every
+        # resolver of the same name shares ONE MemoryStore
+        return memory_store(url[len("mem://"):] or "default")
     if url.startswith("https://"):
         raise ValueError(
             "https:// checkpoint stores are not supported by the "
@@ -481,6 +569,88 @@ def remote_has_step(store, step):
     return store.exists(step_key(step) + "/" + COMPLETE_NAME)
 
 
+def _marker_chunk_refs(store, retry):
+    """sha -> referenced, unioned over every ``COMPLETE`` marker the
+    store holds RIGHT NOW.  Markers are the commit instants, so this is
+    the authoritative liveness set for the chunk sweep; a marker that
+    vanishes mid-read (a concurrent prune) contributes nothing."""
+    refs = set()
+    for key in store.list(STEP_PREFIX):
+        if not _STEP_KEY_RE.match(key):
+            continue
+        try:
+            marker = json.loads(retry.call(
+                store.get_bytes, key).decode("utf-8"))
+            refs.update(str(s) for s in marker.get("chunks", []))
+        except (FileNotFoundError, ValueError, KeyError, TypeError,
+                AttributeError):
+            continue
+    return refs
+
+
+def prune_remote(store, keep, retry=None):
+    """Retire mirrored steps past the newest ``keep`` — the remote
+    analogue of local ``max_to_keep`` retention; -> stats dict.
+
+    Deletion order mirrors the push protocol REVERSED, so the store
+    can never hold a marked-but-gutted step: a doomed step's
+    ``COMPLETE`` marker is deleted FIRST (the step vanishes from
+    :func:`remote_steps` at that instant — the commit point of its
+    retirement), then its per-step files, and finally a conservative
+    CAS sweep removes chunks no SURVIVING marker references — with the
+    reference set recomputed from every marker present at sweep time,
+    so a step pushed concurrently with the prune keeps the chunks its
+    just-written marker names.  (The matching race on the pusher's
+    side — exists-skip, then the chunk vanishes before its marker
+    lands — is closed in :func:`push_step` by re-verifying chunks
+    right before the marker write.)
+
+    ``keep <= 0`` is a no-op by contract (retention off).  Each delete
+    runs under the ``"ckpt.push"`` retry surface.
+    """
+    import time as _time
+
+    from dist_keras_tpu.observability import events, metrics
+
+    t0 = _time.perf_counter()
+    keep = int(keep)
+    if keep <= 0:
+        return {"pruned_steps": [], "deleted_objects": 0,
+                "swept_chunks": 0}
+    retry = retry or _default_retry("ckpt.push")
+    steps = remote_steps(store)
+    doomed = steps[:-keep] if len(steps) > keep else []
+    if not doomed:
+        return {"pruned_steps": [], "deleted_objects": 0,
+                "swept_chunks": 0}
+    deleted = 0
+    for step in doomed:
+        root_key = step_key(step)
+        # marker first: the retirement's commit instant — a crash
+        # between here and the file deletes leaves garbage objects
+        # (swept by the next prune), never a half-fetchable step
+        retry.call(store.delete, root_key + "/" + COMPLETE_NAME)
+        deleted += 1
+        for key in store.list(root_key + "/"):
+            retry.call(store.delete, key)
+            deleted += 1
+    # conservative CAS sweep: liveness recomputed from EVERY marker
+    # present now (not just the survivors of this prune), so
+    # concurrent pushes keep their chunks
+    referenced = _marker_chunk_refs(store, retry)
+    swept = 0
+    for key in store.list(CHUNK_PREFIX):
+        if key[len(CHUNK_PREFIX):] not in referenced:
+            retry.call(store.delete, key)
+            swept += 1
+    metrics.counter("ckpt.remote_pruned").inc(len(doomed))
+    events.emit("ckpt_remote_prune", steps=list(doomed), kept=keep,
+                objects=deleted + swept, chunks_swept=swept,
+                duration_s=_time.perf_counter() - t0)
+    return {"pruned_steps": list(doomed), "deleted_objects": deleted,
+            "swept_chunks": swept}
+
+
 def _same_remote_content(store, step_path, files, root_key, retry):
     """True when the remote copy of this step holds the SAME content
     as the local one — judged by byte-comparing every integrity
@@ -567,6 +737,15 @@ def push_step(store, directory, step, step_path, retry=None):
         pushed += retry.call(_put_chunk, sha)
     for rel in sorted(files):
         pushed += retry.call(_put_file, rel)
+    # close the dedup-skip race against a concurrent prune_remote: a
+    # chunk skipped above because "already mirrored" may have been
+    # swept between that exists() and this instant (prune saw no marker
+    # referencing it yet — ours lands only below).  Re-running the
+    # chunk loop is cheap (exists-check per sha) and re-uploads exactly
+    # the swept ones, so the marker we are about to write never names a
+    # chunk the store no longer holds.
+    for sha in chunks:
+        pushed += retry.call(_put_chunk, sha)
     retry.call(_put_marker)
     metrics.counter("ckpt.bytes_pushed").inc(pushed)
     events.emit("ckpt_push", step=step, files=len(files),
@@ -678,7 +857,7 @@ class CheckpointUploader:
     raise."""
 
     def __init__(self, checkpointer, store=None, poll_s=None,
-                 retry=None):
+                 retry=None, remote_keep=None):
         self.checkpointer = checkpointer
         self.store = store if store is not None else store_from_env()
         if self.store is None:
@@ -688,6 +867,14 @@ class CheckpointUploader:
         self.poll_s = (float(knobs.get("DK_CKPT_REMOTE_POLL_S"))
                        if poll_s is None else float(poll_s))
         self._retry = retry or _default_retry("ckpt.push")
+        # remote retention horizon: explicit arg > DK_CKPT_REMOTE_KEEP
+        # > follow the local checkpointer's max_to_keep; 0 = never
+        # prune (the pre-round-20 accumulate-forever behavior)
+        if remote_keep is None:
+            remote_keep = knobs.get("DK_CKPT_REMOTE_KEEP")
+        if remote_keep is None:
+            remote_keep = getattr(checkpointer, "max_to_keep", 0)
+        self.remote_keep = int(remote_keep)
         self.last_pushed = None
         self.pushes = 0
         self.errors = 0
@@ -723,6 +910,13 @@ class CheckpointUploader:
             # dklint: ignore[unguarded-shared-write] monotonic best-effort counter; same single-driver contract
             self.pushes += 1
             n += 1
+        if n and self.remote_keep > 0:
+            # retention rides the same poll: once fresh steps mirrored,
+            # steps past the horizon retire (ckpt.remote_pruned /
+            # ckpt_remote_prune record it).  Only after a push — an
+            # idle poll must never delete anything.
+            prune_remote(self.store, self.remote_keep,
+                         retry=self._retry)
         return n
 
     def drain(self):

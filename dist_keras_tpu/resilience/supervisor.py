@@ -58,9 +58,9 @@ import json
 import os
 import subprocess
 import sys
-import time
 from collections import deque
 
+from dist_keras_tpu.resilience import world as _world
 from dist_keras_tpu.resilience.preemption import Preempted
 from dist_keras_tpu.resilience.retry import RetryPolicy
 from dist_keras_tpu.utils import knobs
@@ -116,7 +116,7 @@ def alert(kind, **fields):
     the one delivery a fleet operator sees live, and an unattributable
     page from an 8-host pod is half an alert (the event log gets rank
     from its writer; this seam must carry it itself)."""
-    payload = {"kind": str(kind), "t": time.time(), **fields}
+    payload = {"kind": str(kind), "t": _world.time(), **fields}
     if "rank" not in payload:
         try:
             from dist_keras_tpu.observability import events
@@ -171,7 +171,7 @@ class RestartBudget:
     budget lives; the first recording that overflows the window returns
     False and :attr:`evidence` holds the window's failures."""
 
-    def __init__(self, max_restarts, window_s, clock=time.monotonic):
+    def __init__(self, max_restarts, window_s, clock=None):
         if int(max_restarts) < 0:
             raise ValueError(
                 f"max_restarts={max_restarts} must be >= 0")
@@ -179,7 +179,8 @@ class RestartBudget:
             raise ValueError(f"budget window {window_s}s must be > 0")
         self.max_restarts = int(max_restarts)
         self.window_s = float(window_s)
-        self.clock = clock
+        # None -> the world seam (sim clocks govern the rolling window)
+        self.clock = _world.monotonic if clock is None else clock
         self._events = deque()
 
     def record(self, error_name, detail=""):
@@ -208,7 +209,7 @@ def _default_fatal():
 def supervise(fn, checkpointer=None, *, max_restarts=3,
               budget_window_s=300.0, backoff=0.5, multiplier=2.0,
               max_delay=30.0, deadline_s=None, fatal=None,
-              sleep=time.sleep, clock=time.monotonic, on_restart=None):
+              sleep=None, clock=None, on_restart=None):
     """Run ``fn`` under the auto-resume restart loop; -> ``fn``'s
     return value from the attempt that completed.
 
@@ -235,6 +236,10 @@ def supervise(fn, checkpointer=None, *, max_restarts=3,
     from dist_keras_tpu.resilience import preemption
 
     fatal = _default_fatal() if fatal is None else tuple(fatal)
+    # None -> the world seam; a SimWorld installed around this call
+    # drives the budget window, backoff sleeps and the deadline alike
+    sleep = _world.sleep if sleep is None else sleep
+    clock = _world.monotonic if clock is None else clock
     budget = RestartBudget(max_restarts, budget_window_s, clock=clock)
     policy = RetryPolicy(attempts=max_restarts + 1, backoff=backoff,
                          multiplier=multiplier, max_delay=max_delay,

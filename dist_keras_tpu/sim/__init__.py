@@ -1,0 +1,34 @@
+"""Deterministic cluster simulator (round 20).
+
+A thousand-host chaos scenario costs a thousand hosts — unless the
+runtime's environment seams (clock, sleep, transport, process
+spawn/kill, disk) are injectable.  Round 19's components already take
+``clock=``/``sleep=`` in places; this package closes the loop: a
+:class:`~dist_keras_tpu.sim.world.SimWorld` installs itself behind the
+:mod:`~dist_keras_tpu.resilience.world` seam and the REAL components —
+retry policies, supervisors, the PS center variable, ``launch.Job``'s
+relaunch waves, the remote checkpoint store — run at the speed of
+arithmetic under a seeded scheduler, with every run replayable
+bit-for-bit from its seed.
+
+Entry points:
+
+- ``python -m dist_keras_tpu.sim --scenario ps_churn --hosts 1000``
+  runs one scenario and prints a JSON verdict as its last stdout line.
+- :func:`run_scenario` is the library surface the CLI, the CI gate
+  (``tools/gates.py --sim-only``) and the benchmark's ``sim_swarm``
+  row all share.
+
+Scenario scripts live in :mod:`~dist_keras_tpu.sim.scenarios`; the
+simulated clock/scheduler in :mod:`~dist_keras_tpu.sim.world`.
+"""
+
+from dist_keras_tpu.sim.runner import run_scenario
+from dist_keras_tpu.sim.scenarios import SCENARIOS, ScenarioFailed
+from dist_keras_tpu.sim.world import (SIM_EPOCH, SimTimeLimitExceeded,
+                                      SimWorld)
+
+__all__ = [
+    "SIM_EPOCH", "SimWorld", "SimTimeLimitExceeded",
+    "SCENARIOS", "ScenarioFailed", "run_scenario",
+]

@@ -1,0 +1,73 @@
+"""CLI: ``python -m dist_keras_tpu.sim --scenario ps_churn``.
+
+Runs one scenario (or ``--scenario all``) and prints a single JSON
+document as the LAST stdout line — the contract ``tools/bench.py``'s
+``sim_swarm`` row and ``tools/gates.py --sim-only`` both parse.  Exit
+code 0 iff every scenario's invariants held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from dist_keras_tpu.sim.runner import run_scenario
+from dist_keras_tpu.sim.scenarios import SCENARIOS, ScenarioFailed
+from dist_keras_tpu.sim.world import SimTimeLimitExceeded
+from dist_keras_tpu.utils import knobs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m dist_keras_tpu.sim",
+        description="deterministic cluster simulator")
+    ap.add_argument("--scenario", default="ps_churn",
+                    choices=sorted(SCENARIOS) + ["all"],
+                    help="scenario script to run (default: ps_churn)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="scheduler seed (default: DK_SIM_SEED)")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="simulated host/writer count (default: "
+                         "DK_SIM_HOSTS for ps_churn, per-scenario "
+                         "defaults otherwise)")
+    ap.add_argument("--time-limit-s", type=float, default=None,
+                    help="simulated-time horizon before a would-be "
+                         "hang dies typed (default: "
+                         "DK_SIM_TIME_LIMIT_S)")
+    args = ap.parse_args(argv)
+
+    names = (sorted(SCENARIOS) if args.scenario == "all"
+             else [args.scenario])
+    hosts = args.hosts
+    if hosts is None and args.scenario == "ps_churn":
+        hosts = knobs.get("DK_SIM_HOSTS")
+    out = {"scenarios": [], "passed": True}
+    rc = 0
+    for name in names:
+        t0 = time.perf_counter()  # wall clock: measured OUTSIDE the sim
+        try:
+            result = run_scenario(name, seed=args.seed, hosts=hosts,
+                                  time_limit_s=args.time_limit_s)
+            result["wall_s"] = round(time.perf_counter() - t0, 3)
+        except (ScenarioFailed, SimTimeLimitExceeded) as e:
+            result = {"scenario": name, "error": type(e).__name__,
+                      "detail": str(e)[:500],
+                      "wall_s": round(time.perf_counter() - t0, 3)}
+            out["passed"] = False
+            rc = 1
+        out["scenarios"].append(result)
+        print(f"[sim] {name}: "
+              + ("FAILED " + result.get("error", "")
+                 if "error" in result else
+                 f"ok (sim {result['sim_elapsed_s']:.1f}s, "
+                 f"wall {result['wall_s']:.1f}s, "
+                 f"digest {result['digest'][:12]})"),
+              file=sys.stderr)
+    print(json.dumps(out))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Scenario runner — install the sim world, run the script, verdict.
+
+Separated from ``__init__`` so the CLI, the gate and tests share one
+entry point without importing the argparse layer.
+"""
+
+from __future__ import annotations
+
+from dist_keras_tpu.observability import events
+from dist_keras_tpu.resilience import world as _world
+from dist_keras_tpu.sim.scenarios import SCENARIOS
+from dist_keras_tpu.sim.world import SimWorld
+from dist_keras_tpu.utils import knobs
+
+
+def run_scenario(name, seed=None, hosts=None, time_limit_s=None,
+                 workdir=None):
+    """Run one named scenario under a fresh :class:`SimWorld`;
+    -> result dict (scenario, seed, digest, trace_len, sim_elapsed_s
+    + the scenario's own fields).  Raises
+    :class:`~dist_keras_tpu.sim.scenarios.ScenarioFailed` on a
+    violated invariant and
+    :class:`~dist_keras_tpu.sim.world.SimTimeLimitExceeded` on a
+    would-be hang — never returns a half-verdict.
+
+    Defaults resolve the ``DK_SIM_*`` knobs, so the launcher-exported
+    configuration governs here like everywhere else.
+    """
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; valid: "
+            + ", ".join(sorted(SCENARIOS)))
+    seed = int(knobs.get("DK_SIM_SEED") if seed is None else seed)
+    if time_limit_s is None:
+        time_limit_s = knobs.get("DK_SIM_TIME_LIMIT_S")
+    world = SimWorld(seed=seed, time_limit_s=time_limit_s)
+    events.emit("sim_scenario_begin", scenario=name, seed=seed,
+                hosts=hosts)
+    with _world.use(world):
+        result = fn(world, hosts=hosts, workdir=workdir)
+    result = dict(result)
+    result.update({
+        "scenario": name,
+        "seed": seed,
+        "sim_elapsed_s": round(world.elapsed, 6),
+        "trace_len": len(world.trace),
+        "digest": world.digest(),
+    })
+    events.emit("sim_scenario_end", scenario=name, seed=seed,
+                digest=result["digest"],
+                sim_elapsed_s=result["sim_elapsed_s"],
+                trace_len=result["trace_len"])
+    return result

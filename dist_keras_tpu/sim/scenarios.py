@@ -1,0 +1,586 @@
+"""Seeded chaos scenarios over the simulated cluster.
+
+Each scenario is a function ``(world, **knobs) -> result dict`` run
+under an installed :class:`~dist_keras_tpu.sim.world.SimWorld` — real
+runtime components (the in-process PS swarm, ``supervise``,
+``launch.Job``'s relaunch waves, the remote checkpoint store) driven by
+the world's seeded PRNG, with every observable action appended to the
+world's trace.  Two runs with the same seed must produce bit-identical
+trace digests; that equality is the replay contract the test suite and
+the CI gate enforce.
+
+The scenarios:
+
+- ``ps_churn`` — the flagship: a thousand-worker PS swarm on the
+  quadratic model, with >10% of hosts killed (leases reaped) and
+  rejoined, plus one partition-then-heal window.  Converges past the
+  0.80 accuracy floor; every fault is typed or absorbed.
+- ``partition_heal`` — a focused partition window over a smaller
+  swarm: retries absorb what the heal reaches, the rest die typed
+  (``PSUnavailable``), nobody hangs.
+- ``preemption_storm`` — coordinated preemptions: each host runs
+  under ``supervise`` with a seeded number of :class:`Preempted`
+  strikes; budgets and backoffs tick on the sim clock; over-budget
+  hosts die typed (``CrashLoop``).
+- ``relaunch_waves`` — ``launch.Job.supervise_run`` against simulated
+  hosts (the ``runner`` seam + sim-time heartbeat stamps): a transient
+  host death triggers a whole-pod wave, a repeat offender is dropped
+  by an elastic resize, and an all-rc-0 pod ends supervision.
+- ``gc_race`` — many writers mirroring differential checkpoints into
+  one in-memory store interleaved with ``prune_remote``: after every
+  prune, every surviving ``COMPLETE`` step is fully fetchable.
+
+Scenario outcomes are *asserted* here (a violated invariant raises
+:class:`ScenarioFailed`), so a scenario that returns IS its own green
+verdict — the CLI and the gate only relay it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+import numpy as np
+
+from dist_keras_tpu.observability import metrics as _metrics
+from dist_keras_tpu.ps.center import StaleCommit
+from dist_keras_tpu.ps.client import PSUnavailable
+from dist_keras_tpu.ps.inproc import InProcPSClient, InProcPSServer
+from dist_keras_tpu.resilience import preemption
+from dist_keras_tpu.resilience import store as _store
+from dist_keras_tpu.resilience.supervisor import CrashLoop, supervise
+
+
+class ScenarioFailed(AssertionError):
+    """A scenario invariant did not hold — the sim's typed red verdict."""
+
+
+def _require(cond, what):
+    if not cond:
+        raise ScenarioFailed(what)
+
+
+# ---------------------------------------------------------------------
+# the PS swarm engine (ps_churn / partition_heal share it)
+# ---------------------------------------------------------------------
+
+def _ps_swarm(world, hosts, steps_per_host, *, kill_frac=0.0,
+              partition_at=None, partition_s=2.0, tick_s=0.01,
+              dim=8, lr=1.0, staleness_cap=None):
+    """Run ``hosts`` asynchronous workers against one in-process
+    center variable on the quadratic model ``f(w)=0.5||w-w*||^2``
+    (whose exact gradient step makes convergence a pure function of
+    the DynSGD staleness algebra, not of data).  The world's PRNG
+    owns the interleaving: each turn one runnable host advances one
+    phase (join -> commit -> pull -> commit -> ...), so staleness
+    emerges from the schedule exactly as it does from real racing
+    workers.  Kills/reaps/rejoins and the partition window fire at
+    scripted sim times.  -> result dict (asserted converged)."""
+    rng = world.rng
+    nrng = np.random.default_rng(world.seed)
+    w_star = nrng.standard_normal(dim).astype(np.float32)
+    c0 = np.zeros(dim, np.float32)
+    d0 = float(np.linalg.norm(c0 - w_star))
+    # the chaos script scales with the run's nominal span so the same
+    # scenario shape works at 50 hosts (tests) and 1000 (the gate)
+    est_span = hosts * (2 * steps_per_host + 1) * tick_s
+    lease_s = max(5.0, 0.25 * est_span)
+    server = InProcPSServer(
+        {"w": c0.copy()}, window=1, lease_s=lease_s,
+        staleness_cap=(50 * hosts if staleness_cap is None
+                       else staleness_cap))
+    part = {"on": False}
+    swarm = []
+    for h in range(hosts):
+        client = InProcPSClient(
+            server, attempts=4, backoff=0.05, jitter=0.1,
+            partitioned=lambda: part["on"],
+            seed=world.seed * 1_000_003 + h)
+        swarm.append({"h": h, "client": client, "wid": None,
+                      "version": None, "center": None, "steps": 0,
+                      "alive": True, "phase": "join", "faults": 0})
+
+    kill_n = int(round(hosts * kill_frac))
+    killed = []         # chosen AT the kill instant, from joined hosts
+    killed_wids = set()
+    t_kill = 0.08 * est_span
+    script = []
+    if kill_n:
+        script += [(t_kill, "kill"),
+                   (t_kill + lease_s + 2.0, "reap"),
+                   (t_kill + lease_s + 3.0, "rejoin")]
+    if partition_at is not None:
+        script += [(partition_at, "part_on"),
+                   (partition_at + partition_s, "part_off")]
+    script.sort()
+    si = 0
+    typed_faults = 0
+    reaped = []
+
+    active = list(swarm)
+    # run until the hosts are done AND the chaos script is spent: a
+    # small swarm can finish its steps before the reap/rejoin instants,
+    # and skipping those silently would un-test the very churn the
+    # scenario exists to exercise (the idle advance below jumps straight
+    # to the next scripted instant; at gate scale the loop never idles)
+    while active or si < len(script):
+        while si < len(script) and script[si][0] <= world.elapsed:
+            _, ev = script[si]
+            si += 1
+            if ev == "kill":
+                # victims are drawn from hosts that have JOINED — a
+                # never-joined host holds no lease, so killing it
+                # proves nothing about reaping (and at small scales a
+                # big fraction may not have had a first turn yet)
+                joined = [hv["h"] for hv in swarm
+                          if hv["wid"] is not None]
+                _require(len(joined) >= kill_n,
+                         f"only {len(joined)} hosts joined by the "
+                         f"kill instant — cannot kill {kill_n}")
+                killed = sorted(rng.sample(joined, kill_n))
+                killed_wids = {swarm[h]["wid"] for h in killed}
+                for h in killed:
+                    swarm[h]["alive"] = False
+                active = [hv for hv in active if hv["alive"]]
+                world.record("kill", hosts=tuple(killed))
+            elif ev == "reap":
+                reaped = server.reap()
+                world.record("reap", lapsed=len(reaped))
+            elif ev == "rejoin":
+                for h in killed:
+                    swarm[h]["alive"] = True
+                    swarm[h]["phase"] = "join"
+                active = [hv for hv in swarm
+                          if hv["alive"]
+                          and hv["steps"] < steps_per_host]
+                world.record("rejoin", hosts=tuple(killed))
+            elif ev == "part_on":
+                part["on"] = True
+                world.record("partition", on=True)
+            else:
+                part["on"] = False
+                world.record("partition", on=False)
+        if not active:
+            if si < len(script):  # idle until the next scripted event
+                world.advance(max(tick_s,
+                                  script[si][0] - world.elapsed))
+                continue
+            break
+        hv = active[rng.randrange(len(active))]
+        try:
+            if hv["phase"] == "join":
+                r = hv["client"].join(wid=hv["wid"], rank=hv["h"])
+                hv["wid"] = r["wid"]
+                hv["version"], hv["center"] = r["version"], r["center"]
+                hv["phase"] = "commit"
+                world.record("join", host=hv["h"],
+                             version=r["version"],
+                             rejoined=bool(r["rejoined"]))
+            elif hv["phase"] == "pull":
+                r = hv["client"].pull(wid=hv["wid"])
+                hv["version"], hv["center"] = r["version"], r["center"]
+                hv["phase"] = "commit"
+            else:  # commit: the exact quadratic gradient step
+                delta = {"w": (lr * (w_star - hv["center"]["w"]))
+                         .astype(np.float32)}
+                r = hv["client"].commit(hv["wid"], hv["version"],
+                                        delta, rank=hv["h"])
+                hv["version"], hv["center"] = r["version"], r["center"]
+                hv["steps"] += 1
+                hv["phase"] = "pull"
+                _metrics.counter("sim.host_steps").inc()
+                world.record("commit", host=hv["h"],
+                             version=r["version"],
+                             staleness=int(r["staleness"]),
+                             rejoined=bool(r["rejoined"]))
+                if hv["steps"] >= steps_per_host:
+                    active.remove(hv)
+        except StaleCommit as e:
+            # typed: the worker's recovery is a fresh pull
+            hv["faults"] += 1
+            typed_faults += 1
+            _metrics.counter("sim.faults").inc()
+            world.record("fault", host=hv["h"], kind="StaleCommit",
+                         staleness=int(e.staleness))
+            hv["phase"] = "pull"
+        except PSUnavailable:
+            # typed after the retry budget (the absorbed occurrences
+            # never surface here — that is the point of the policy)
+            hv["faults"] += 1
+            typed_faults += 1
+            _metrics.counter("sim.faults").inc()
+            world.record("fault", host=hv["h"], kind="PSUnavailable")
+            hv["phase"] = "pull" if hv["wid"] is not None else "join"
+        world.advance(tick_s)
+
+    _require(not part["on"], "partition never healed")
+    if killed:
+        _require(killed_wids <= {w for w, _ in reaped},
+                 "killed hosts' leases were never reaped")
+    clock, center = server.center.state()
+    accuracy = 1.0 - float(np.linalg.norm(center["w"] - w_star)) / d0
+    stats = server.center.stats()
+    result = {
+        "hosts": hosts,
+        "steps_per_host": steps_per_host,
+        "commits": clock,
+        "accuracy": round(accuracy, 6),
+        "typed_faults": typed_faults,
+        "killed": len(killed),
+        "reaped": len(reaped),
+        "lapses": stats["lapsed_total"],
+        "sleeps": world.sleeps,
+    }
+    _require(accuracy >= 0.80,
+             f"center accuracy {accuracy:.3f} below the 0.80 floor")
+    return result
+
+
+def ps_churn(world, hosts=None, workdir=None):
+    """1000-worker swarm with >=12% of hosts killed/rejoined and one
+    partition healed mid-run."""
+    hosts = 1000 if hosts is None else int(hosts)
+    steps = 3
+    est_span = hosts * (2 * steps + 1) * 0.01
+    result = _ps_swarm(world, hosts, steps, kill_frac=0.12,
+                       partition_at=0.7 * est_span, partition_s=2.0)
+    _require(result["killed"] >= max(1, int(0.10 * hosts)),
+             "churn scenario must kill >=10% of hosts")
+    _require(result["reaped"] >= result["killed"],
+             "killed hosts' leases were never reaped")
+    return result
+
+
+def partition_heal(world, hosts=None, workdir=None):
+    """Partition the whole swarm mid-run; retries absorb what the heal
+    reaches, the rest surface typed — and the run still converges."""
+    hosts = 64 if hosts is None else int(hosts)
+    steps = 4
+    est_span = hosts * (2 * steps + 1) * 0.01
+    return _ps_swarm(world, hosts, steps, kill_frac=0.0,
+                     partition_at=0.5 * est_span, partition_s=1.5)
+
+
+# ---------------------------------------------------------------------
+# preemption storm (supervise on the sim clock)
+# ---------------------------------------------------------------------
+
+def preemption_storm(world, hosts=None, workdir=None):
+    """Every host trains under ``supervise``; a seeded number of
+    preemption strikes hits each one.  Hosts within the restart budget
+    complete; hosts past it die typed (``CrashLoop``).  All budget
+    windows and backoff sleeps tick on the sim clock."""
+    hosts = 40 if hosts is None else int(hosts)
+    rng = world.rng
+    max_restarts = 3
+    completed = crash_loops = restarts = 0
+    for h in range(hosts):
+        strikes = rng.choice([0, 0, 1, 1, 2, 3, 5])
+        state = {"left": strikes}
+
+        def body(attempt, resume_step, state=state, h=h):
+            world.advance(0.05)  # one sim "training chunk"
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise preemption.Preempted(15)
+            return h
+
+        t0 = world.elapsed
+        try:
+            supervise(body, max_restarts=max_restarts,
+                      budget_window_s=3600.0, backoff=0.2,
+                      multiplier=2.0)
+            completed += 1
+            restarts += strikes
+            world.record("supervised", host=h, strikes=strikes,
+                         outcome="completed",
+                         sim_s=round(world.elapsed - t0, 9))
+        except CrashLoop:
+            crash_loops += 1
+            world.record("supervised", host=h, strikes=strikes,
+                         outcome="crash_loop")
+        finally:
+            preemption.clear()
+    _require(completed + crash_loops == hosts,
+             "every host must end completed or typed")
+    _require(crash_loops == 0 or restarts > 0,
+             "storm produced no restarts at all")
+    expected_loops = sum(
+        1 for e in world.trace
+        if e[1] == "supervised"
+        and dict(e[2]).get("strikes", 0) > max_restarts)
+    _require(crash_loops == expected_loops,
+             f"crash loops {crash_loops} != over-budget hosts "
+             f"{expected_loops}")
+    return {"hosts": hosts, "completed": completed,
+            "crash_loops": crash_loops, "restarts": restarts,
+            "sleeps": world.sleeps}
+
+
+# ---------------------------------------------------------------------
+# rolling relaunch waves (launch.Job's runner seam)
+# ---------------------------------------------------------------------
+
+def relaunch_waves(world, hosts=None, workdir=None):
+    """``Job.supervise_run`` against simulated hosts: the ``runner``
+    seam replaces ssh/rsync, heartbeat files are stamped with SIM time
+    (``os.utime``), and chaos timers kill hosts under the supervisor's
+    feet.  A transient death triggers a whole-pod wave; a permanent
+    one is dropped by an elastic resize; all-rc-0 ends the run."""
+    import re as _re
+
+    from dist_keras_tpu.launch.job import Job
+
+    hosts = 6 if hosts is None else max(4, int(hosts))
+    own = workdir is None
+    if own:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="dk-sim-waves-")
+    try:
+        coord = os.path.join(workdir, "coord")
+        jobdir = os.path.join(workdir, "job")
+        os.makedirs(coord, exist_ok=True)
+        os.makedirs(jobdir, exist_ok=True)
+        names = [f"sim{r}" for r in range(hosts)]
+        alive = {}        # host name -> (session, rank)
+        perma_dead = set()
+
+        def _hb_root(session):
+            root = coord if not session else os.path.join(
+                coord, str(session))
+            return os.path.join(root, "hb")
+
+        def _stamp(session, rank):
+            hb = _hb_root(session)
+            os.makedirs(hb, exist_ok=True)
+            path = os.path.join(hb, f"rank_{rank}")
+            with open(path, "w") as f:
+                f.write(repr(world.time()))
+            t = world.time()
+            os.utime(path, (t, t))
+
+        def runner(cmd):
+            if cmd[0] == "rsync":
+                return 0
+            host, shell = cmd[1], cmd[2]
+            if "nohup" in shell:
+                sess_m = _re.search(r"DK_COORD_SESSION=(\d+)", shell)
+                rank = int(_re.search(r"DK_COORD_RANK=(\d+)",
+                                      shell).group(1))
+                session = int(sess_m.group(1)) if sess_m else 0
+                world.record("launch", host=host, rank=rank,
+                             session=session)
+                if host in perma_dead:
+                    # launches, instantly dies dark: no beat, no rc —
+                    # exactly the repeat-offender evidence shape
+                    return 0
+                alive[host] = (session, rank)
+                _stamp(session, rank)
+                return 0
+            if "kill -s TERM" in shell:
+                alive.pop(host, None)
+                return 0
+            return 0
+
+        def beat():
+            for host, (session, rank) in sorted(alive.items()):
+                _stamp(session, rank)
+            world.call_later(1.0, beat)
+
+        world.call_later(1.0, beat)
+
+        job = Job("sim-secret", "simwaves", jobdir, hosts=names,
+                  coord_dir=coord, runner=runner,
+                  trace_id="0" * 32,
+                  supervise={"max_restarts": 4,
+                             "budget_window_s": 100000.0,
+                             "interval_s": 2.0, "grace_s": 4.0,
+                             "elastic": True, "min_world": 2})
+
+        transient, permanent = names[2], names[hosts - 2]
+
+        def kill_transient():
+            alive.pop(transient, None)
+            world.record("host_dark", host=transient, kind="transient")
+
+        def kill_permanent():
+            perma_dead.add(permanent)
+            alive.pop(permanent, None)
+            world.record("host_dark", host=permanent, kind="permanent")
+
+        world.call_later(6.0, kill_transient)
+        world.call_later(20.0, kill_permanent)
+
+        done = {"wrote_rc": False}
+
+        def maybe_finish():
+            # once the pod is stable at hosts-1 survivors (the elastic
+            # resize landed), record rc 0 for every live rank: the
+            # supervisor's positive completed evidence
+            if (not done["wrote_rc"]
+                    and len(alive) == len(job.hosts)
+                    and permanent not in job.hosts
+                    and len(job.hosts) == hosts - 1):
+                for host, (session, rank) in sorted(alive.items()):
+                    root = coord if not session else os.path.join(
+                        coord, str(session))
+                    os.makedirs(os.path.join(root, "rc"),
+                                exist_ok=True)
+                    with open(os.path.join(root, "rc",
+                                           f"rank_{rank}"), "w") as f:
+                        f.write("0")
+                done["wrote_rc"] = True
+                world.record("run_complete_rc", ranks=len(alive))
+            if not done["wrote_rc"]:
+                world.call_later(2.0, maybe_finish)
+
+        world.call_later(10.0, maybe_finish)
+
+        rc = job.send()
+        _require(rc == 0, f"initial pod launch failed rc={rc}")
+        waves = job.supervise_run(out=None, stale_after_s=3.0)
+        for ranks, session in waves:
+            world.record("wave", session=session,
+                         dead=tuple(sorted(ranks)))
+        _require(len(waves) >= 2,
+                 f"expected >=2 relaunch waves, got {len(waves)}")
+        _require(len(job.hosts) == hosts - 1,
+                 "elastic resize never dropped the permanent host")
+        _require(permanent not in job.hosts,
+                 "the wrong host was dropped")
+        return {"hosts": hosts, "waves": len(waves),
+                "final_world": job.num_processes,
+                "dropped": [permanent], "sleeps": world.sleeps}
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------
+# differential-checkpoint GC races
+# ---------------------------------------------------------------------
+
+def gc_race(world, hosts=None, workdir=None):
+    """Writers mirror differential steps (shared CAS chunk pool) into
+    one in-memory store, interleaved with ``prune_remote`` and seeded
+    transient store failures.  After every prune, every surviving
+    ``COMPLETE`` step must be fully fetchable — marker, files and
+    every referenced chunk present."""
+    writers = 100 if hosts is None else int(hosts)
+    steps = max(3 * writers, 60)
+    keep = 5
+    rng = world.rng
+    own = workdir is None
+    if own:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="dk-sim-gc-")
+    try:
+        local = os.path.join(workdir, "local")
+        cas_dir = os.path.join(local, "chunks")
+        os.makedirs(cas_dir, exist_ok=True)
+        # the shared CAS pool: a handful of chunks referenced by many
+        # steps, so dedup skips + prunes genuinely contend
+        pool = []
+        for i in range(12):
+            data = f"chunk-payload-{i}".encode() * 64
+            sha = hashlib.sha256(data).hexdigest()
+            with open(os.path.join(cas_dir, sha), "wb") as f:
+                f.write(data)
+            pool.append(sha)
+
+        flaky = {"pending": 0, "tripped": 0}
+
+        def gate(op, key):
+            if flaky["pending"] > 0:
+                flaky["pending"] -= 1
+                flaky["tripped"] += 1
+                return True
+            return False
+
+        store = _store.MemoryStore(fail=gate)
+
+        def make_step(step, writer):
+            path = os.path.join(local, f"step_{step:08d}")
+            os.makedirs(path, exist_ok=True)
+            refs = rng.sample(pool, 2)
+            with open(os.path.join(path, "payload.bin"), "wb") as f:
+                f.write(f"payload-{step}-{writer}".encode())
+            with open(os.path.join(path, "chunks.json"), "w") as f:
+                json.dump({"leaves": [
+                    {"files": [f"chunks/{sha}" for sha in refs]}]}, f)
+            return path
+
+        def check_fetchable(tag):
+            for step in _store.remote_steps(store):
+                key = _store.step_key(step)
+                marker = json.loads(store.get_bytes(
+                    key + "/" + _store.COMPLETE_NAME).decode())
+                for rel in marker["files"]:
+                    _require(store.exists(key + "/" + rel),
+                             f"{tag}: step {step} lost file {rel}")
+                for sha in marker["chunks"]:
+                    _require(
+                        store.exists(_store.CHUNK_PREFIX + sha),
+                        f"{tag}: step {step} lost chunk {sha[:12]}")
+
+        pushed = pruned_total = 0
+        next_step = 1
+        while next_step <= steps:
+            if rng.random() < 0.12 and pushed > keep:
+                st = _store.prune_remote(store, keep)
+                pruned_total += len(st["pruned_steps"])
+                world.record("prune",
+                             steps=tuple(st["pruned_steps"]),
+                             swept=st["swept_chunks"])
+                check_fetchable("post-prune")
+                world.record("gc_check",
+                             surviving=len(_store.remote_steps(store)))
+            else:
+                if rng.random() < 0.08:
+                    # one transient refusal; every push op runs under
+                    # the ckpt.push retry surface, so it is absorbed
+                    # (prune's list calls are NOT retried — flaking
+                    # those would test nothing this repo promises)
+                    flaky["pending"] = 1
+                writer = rng.randrange(writers)
+                path = make_step(next_step, writer)
+                st = _store.push_step(store, local, next_step, path)
+                world.record("push", step=next_step, writer=writer,
+                             skipped=bool(st["skipped"]))
+                shutil.rmtree(path, ignore_errors=True)
+                pushed += 1
+                next_step += 1
+            world.advance(0.01)
+        final = _store.prune_remote(store, keep)
+        pruned_total += len(final["pruned_steps"])
+        check_fetchable("final")
+        surviving = _store.remote_steps(store)
+        _require(len(surviving) == keep,
+                 f"retention horizon violated: {len(surviving)} "
+                 f"steps survive, keep={keep}")
+        # the newest survivor must round-trip through the real heal
+        # path (chunk re-hash included)
+        heal_dir = os.path.join(workdir, "heal")
+        os.makedirs(heal_dir, exist_ok=True)
+        stage = _store.fetch_step(store, heal_dir, surviving[-1])
+        _require(os.path.isfile(os.path.join(stage, "payload.bin")),
+                 "healed step is missing its payload")
+        return {"writers": writers, "steps": steps,
+                "pruned": pruned_total, "surviving": len(surviving),
+                "flaky_ops": flaky["tripped"], "keep": keep}
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+SCENARIOS = {
+    "ps_churn": ps_churn,
+    "partition_heal": partition_heal,
+    "preemption_storm": preemption_storm,
+    "relaunch_waves": relaunch_waves,
+    "gc_race": gc_race,
+}

@@ -1,0 +1,142 @@
+"""SimWorld — the deterministic discrete-event clock behind the seam.
+
+The FoundationDB/Jepsen lesson, sized for this repo: the expensive part
+of distributed-systems confidence is not the assertions, it is the
+*scheduler* — who runs when, which sleeps interleave, when the
+partition heals.  :class:`SimWorld` replaces the process's clocks
+through the :mod:`~dist_keras_tpu.resilience.world` seam and makes the
+scheduler a seeded PRNG: every ``sleep`` advances simulated time
+instantly, every timer fires in deterministic order, and the whole
+run's observable history lands in a trace whose SHA-256 digest must be
+bit-identical across replays of the same seed.
+
+What determinism costs (and why it is cheap here):
+
+- **Single-threaded by construction.**  The sim never spawns threads;
+  concurrency is modeled as the scenario's seeded interleaving of
+  per-host actions.  Real threads in real mode still hit
+  :class:`~dist_keras_tpu.resilience.world.RealWorld` — the global
+  world slot only changes inside a scenario.
+- **No wall-clock reads, ever.**  The sim epoch is a fixed constant
+  (:data:`SIM_EPOCH`), so heartbeat stamps, lease expiries and chaos
+  horizons are identical numbers run over run.  ``time`` and
+  ``monotonic`` move in lockstep — staleness judgments compare stamps
+  to the same clock that wrote them.
+- **A hard time limit instead of a hang.**  A scenario that would wait
+  forever (a deadlock, an unhealed partition) trips
+  :class:`SimTimeLimitExceeded` the moment simulated time crosses the
+  horizon — the "never a hang" acceptance is structural, not hoped-for.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import random
+
+from dist_keras_tpu.resilience.world import World
+
+# A fixed, recognizably-fake epoch (2001-09-09T01:46:40Z).  Large so
+# mtime stamps written with sim time look like plausible file times to
+# code that subtracts them, constant so replays are bit-identical.
+SIM_EPOCH = 1_000_000_000.0
+
+
+class SimTimeLimitExceeded(RuntimeError):
+    """Simulated time crossed the scenario's horizon — the typed form
+    of "this would have hung"."""
+
+    def __init__(self, limit_s, now):
+        self.limit_s = float(limit_s)
+        self.now = float(now)
+        super().__init__(
+            f"simulated time {now - SIM_EPOCH:.3f}s crossed the "
+            f"scenario horizon {limit_s:.3f}s — a real cluster would "
+            "still be waiting (deadlock or unhealed fault)")
+
+
+class SimWorld(World):
+    """Deterministic simulated clock + seeded scheduler PRNG + trace.
+
+    ``sleep`` advances :meth:`time`/:meth:`monotonic` instantly, firing
+    any timers scheduled inside the skipped span in (time, insertion)
+    order.  ``rng`` is THE scenario randomness — scenarios draw every
+    choice (which host runs, who dies, when the partition heals) from
+    it so one seed pins the entire interleaving.
+
+    ``record(kind, **fields)`` appends to the trace; :meth:`digest`
+    hashes it.  Only deterministic values may be recorded — the digest
+    equality test across replays is the enforcement.
+    """
+
+    def __init__(self, seed=0, time_limit_s=None, start=SIM_EPOCH):
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self._start = float(start)
+        self._now = float(start)
+        self.time_limit_s = (None if time_limit_s is None
+                             else float(time_limit_s))
+        self._timers = []            # heap of (at, seq, fn)
+        self._seq = itertools.count()
+        self.trace = []
+        self.sleeps = 0              # how many sleeps were absorbed
+
+    # -- the World interface -------------------------------------------
+    def time(self):
+        return self._now
+
+    def monotonic(self):
+        return self._now
+
+    def sleep(self, seconds):
+        self.sleeps += 1
+        self.advance(seconds)
+
+    # -- simulated-time control ----------------------------------------
+    @property
+    def elapsed(self):
+        """Simulated seconds since the scenario began."""
+        return self._now - self._start
+
+    def _check_limit(self):
+        if (self.time_limit_s is not None
+                and self.elapsed > self.time_limit_s):
+            raise SimTimeLimitExceeded(self.time_limit_s, self._now)
+
+    def advance(self, seconds):
+        """Jump the clock forward, firing due timers in order.  Timer
+        callbacks run AT their scheduled instant (``monotonic()``
+        inside one reads the timer's time, not the jump target)."""
+        target = self._now + max(0.0, float(seconds))
+        while self._timers and self._timers[0][0] <= target:
+            at, _, fn = heapq.heappop(self._timers)
+            self._now = max(self._now, at)
+            self._check_limit()
+            fn()
+        self._now = target
+        self._check_limit()
+
+    def call_later(self, delay_s, fn):
+        """Schedule ``fn()`` at now + delay_s (fires inside a future
+        :meth:`advance`/:meth:`sleep` that crosses it)."""
+        return self.call_at(self._now + max(0.0, float(delay_s)), fn)
+
+    def call_at(self, at, fn):
+        heapq.heappush(self._timers, (float(at), next(self._seq), fn))
+
+    # -- the replay trace ----------------------------------------------
+    def record(self, __kind, **fields):
+        """Append one trace entry stamped with the sim clock.  Fields
+        are sorted so dict construction order can never leak into the
+        digest.  (The positional name is mangled so ``kind=`` stays
+        usable as a field key.)"""
+        self.trace.append((round(self.elapsed, 9), str(__kind),
+                           tuple(sorted(fields.items()))))
+
+    def digest(self):
+        """SHA-256 over the full trace — the bit-identity witness."""
+        h = hashlib.sha256()
+        for entry in self.trace:
+            h.update(repr(entry).encode("utf-8"))
+        return h.hexdigest()

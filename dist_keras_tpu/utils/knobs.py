@@ -135,6 +135,12 @@ _register("DK_CKPT_REMOTE_PUSH", True, _parse_bool, kind="bool",
 _register("DK_CKPT_REMOTE_POLL_S", 2.0, float, kind="seconds",
           doc="background uploader poll cadence for newly promoted "
               "steps")
+_register("DK_CKPT_REMOTE_KEEP", None, int,
+          "remote retention horizon: after each uploader poll, "
+          "mirrored steps beyond the newest N are pruned "
+          "(marker-first, then a conservative chunk sweep).  Unset = "
+          "follow the local checkpointer's `max_to_keep`; `0` = never "
+          "prune (the pre-round-20 accumulate-forever behavior)")
 
 # elastic world resize
 _register("DK_ELASTIC", True, _parse_bool, kind="bool",
@@ -162,6 +168,24 @@ _register("DK_FAULTS_HORIZON", 20, int, on_error="raise",
 _register("DK_FAULTS_POINTS", "", str,
           "chaos: comma list restricting the armed point set (unknown "
           "names fail loudly)")
+_register("DK_FAULTS_HORIZON_S", None, float, kind="seconds",
+          on_error="raise",
+          doc="chaos: when set, armed points fire at a random TIME in "
+              "[0, horizon_s) on the world clock instead of a call "
+              "index — simulated seconds under the cluster simulator")
+
+# cluster simulator (python -m dist_keras_tpu.sim)
+_register("DK_SIM_SEED", 0, int,
+          "default scenario seed for the cluster simulator CLI and "
+          "the sim gate — same seed + same scenario = bit-identical "
+          "event trace")
+_register("DK_SIM_HOSTS", 1000, int,
+          "default simulated host count for scenarios that scale by "
+          "world size (ps_churn, preemption_storm, ...)")
+_register("DK_SIM_TIME_LIMIT_S", 3600.0, float, kind="seconds",
+          doc="simulated-time budget per scenario: a scenario still "
+              "running past this much SIM time is declared hung "
+              "(typed verdict, never a wall-clock hang)")
 
 # observability: event log
 _register("DK_OBS_DIR", None, str,

@@ -3095,6 +3095,101 @@ def run_speed_gate(timeout=300):
     }
 
 
+def run_sim_gate(timeout=600):
+    """-> gate record for the deterministic cluster simulator (round
+    20): every scenario script green in one CLI run (1000-host PS
+    churn with kills/rejoins + a healed partition, focused partition
+    heal, preemption storm, elastic relaunch waves, checkpoint GC
+    races), the churn run under its 60s wall budget, and a second
+    seeded run of ``ps_churn`` replaying BIT-IDENTICALLY (trace digest
+    equality across two separate processes)."""
+    t0 = time.time()
+    failures = []
+    detail = {}
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith("DK_")}
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+
+    def _cli(*args):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dist_keras_tpu.sim", *args],
+            capture_output=True, text=True, env=dict(base_env),
+            cwd=REPO, timeout=timeout)
+        lines = proc.stdout.strip().splitlines()
+        doc = json.loads(lines[-1]) if lines else {}
+        return proc, doc
+
+    try:
+        proc, doc = _cli("--scenario", "all", "--seed", "0")
+        for rec in doc.get("scenarios", []):
+            detail[rec["scenario"]] = {
+                "passed": "error" not in rec,
+                "wall_s": rec.get("wall_s"),
+                "sim_elapsed_s": rec.get("sim_elapsed_s"),
+                "digest": rec.get("digest", "")[:16],
+                "error": rec.get("detail", "")[:200]
+                if "error" in rec else "",
+            }
+        if proc.returncode != 0 or not doc.get("passed"):
+            bad = [r["scenario"] for r in doc.get("scenarios", [])
+                   if "error" in r] or ["<no output>"]
+            failures.append(
+                f"scenarios failed: {', '.join(bad)} "
+                f"(rc={proc.returncode}) "
+                f"[{proc.stderr.strip()[-300:]}]")
+        churn = next((r for r in doc.get("scenarios", [])
+                      if r.get("scenario") == "ps_churn"), None)
+        if churn is None or "error" in churn:
+            failures.append("ps_churn produced no verdict")
+        else:
+            if churn.get("hosts") != 1000:
+                failures.append(
+                    f"ps_churn ran {churn.get('hosts')} hosts, "
+                    "not the contracted 1000")
+            if churn.get("wall_s", 1e9) >= 60.0:
+                failures.append(
+                    f"ps_churn took {churn['wall_s']}s wall "
+                    "(budget: <60s)")
+            if churn.get("killed", 0) < 100:
+                failures.append(
+                    f"ps_churn killed only {churn.get('killed')} "
+                    "hosts (<10%)")
+            if churn.get("accuracy", 0.0) < 0.80:
+                failures.append(
+                    f"ps_churn accuracy {churn.get('accuracy')} "
+                    "below 0.80")
+            proc2, doc2 = _cli("--scenario", "ps_churn",
+                               "--seed", "0")
+            replay = (doc2.get("scenarios") or [{}])[0]
+            detail["replay"] = {
+                "digest": replay.get("digest", "")[:16],
+                "matches": replay.get("digest")
+                == churn.get("digest"),
+            }
+            if replay.get("digest") != churn.get("digest"):
+                failures.append(
+                    "ps_churn replay diverged: "
+                    f"{churn.get('digest', '')[:16]} != "
+                    f"{replay.get('digest', '')[:16]}")
+    except subprocess.TimeoutExpired:
+        failures.append(f"HANG (killed at {timeout}s)")
+    except (ValueError, KeyError) as e:
+        failures.append(f"malformed sim output: {e}")
+    return {
+        "name": "cluster_sim",
+        "metric": "scenarios_green_churn_under_60s_replay_identical",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "detail": detail,
+        "failures": failures,
+    }
+
+
 def run_gates(fast=False, timeout=3 * 3600):
     cmd = [sys.executable, "-m", "pytest", "tests/test_examples.py",
            "-q", "-s", "-p", "no:cacheprovider"]
@@ -3173,6 +3268,14 @@ def main():
                          "corruption detection, compressed-PS 2-worker "
                          "accuracy floor at >=2x byte reduction) and "
                          "print its record")
+    ap.add_argument("--sim-only", action="store_true",
+                    help="run just the cluster-simulator gate (every "
+                         "scenario script green — 1000-host PS churn "
+                         "with kills/rejoins and a healed partition "
+                         "under 60s wall, preemption storm, elastic "
+                         "relaunch waves, GC races — plus a seeded "
+                         "ps_churn replay that must be bit-identical) "
+                         "and print its record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -3195,6 +3298,11 @@ def main():
         wd_gate = run_watchdog_gate()
         print(json.dumps(wd_gate, indent=1))
         return 0 if wd_gate["passed"] else 1
+
+    if args.sim_only:
+        sim_gate = run_sim_gate()
+        print(json.dumps(sim_gate, indent=1))
+        return 0 if sim_gate["passed"] else 1
 
     if args.ps_only:
         ps_gate = run_ps_gate()
@@ -3240,6 +3348,7 @@ def main():
     res["gates"].append(run_elastic_gate())
     res["gates"].append(run_ps_gate())
     res["gates"].append(run_speed_gate())
+    res["gates"].append(run_sim_gate())
     res["gates"].append(run_watchdog_gate())
     res["gates"].append(run_lint_gate())
     import platform

@@ -45,8 +45,8 @@ def test_zero_budget_still_yields_complete_record():
     # 9 device configs + CPU serving + CPU ckpt-manifest overhead
     # + CPU ckpt-async-save + CPU diff-ckpt + CPU retrace-proxy
     # attribution + CPU reshard-restore + CPU comm-overlap proxy
-    # + CPU ps-compress
-    assert len(rec["configs"]) == 17
+    # + CPU ps-compress + CPU sim-swarm
+    assert len(rec["configs"]) == 18
     assert all(c.get("skipped") == "budget" for c in rec["configs"])
     # driver-contract top-level keys exist even with no headline run
     for key in ("metric", "value", "unit", "vs_baseline"):

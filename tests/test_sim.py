@@ -1,0 +1,255 @@
+"""Deterministic cluster simulator (round 20).
+
+Three layers under test:
+
+- :class:`~dist_keras_tpu.sim.world.SimWorld` semantics — lockstep
+  ``time``/``monotonic``, sleeps that advance simulated time instantly,
+  timers firing at their scheduled instants, and the typed
+  :class:`~dist_keras_tpu.sim.world.SimTimeLimitExceeded` hang guard.
+- The world seam itself — components built with default ``sleep``/
+  ``clock`` (retry backoff, fault ``delay`` actions, ``chaos_schedule``
+  time horizons) must run on SIMULATED seconds inside
+  ``world.use(SimWorld())`` and restore the real world after.
+- The scenario scripts — every scenario replays bit-identically from
+  its seed (the SHA-256 trace digest is the witness), and small runs of
+  each uphold their invariants without the gate-sized host counts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dist_keras_tpu.resilience import faults
+from dist_keras_tpu.resilience import world as _world
+from dist_keras_tpu.resilience.retry import RetryPolicy
+from dist_keras_tpu.resilience.world import RealWorld
+from dist_keras_tpu.sim import (SIM_EPOCH, SCENARIOS, SimTimeLimitExceeded,
+                                SimWorld, run_scenario)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------- SimWorld
+
+
+def test_clocks_lockstep_and_sleep_advances_instantly():
+    w = SimWorld(seed=0)
+    assert w.time() == w.monotonic() == SIM_EPOCH
+    t0 = time.perf_counter()
+    w.sleep(3600.0)  # an hour of simulated time
+    wall = time.perf_counter() - t0
+    assert w.time() == w.monotonic() == SIM_EPOCH + 3600.0
+    assert w.elapsed == 3600.0
+    assert w.sleeps == 1
+    assert wall < 1.0  # absorbed, not slept
+
+
+def test_timers_fire_in_order_at_their_instants():
+    w = SimWorld(seed=0)
+    fired = []
+    w.call_later(2.0, lambda: fired.append(("b", w.monotonic())))
+    w.call_later(1.0, lambda: fired.append(("a", w.monotonic())))
+    # same instant as "a": insertion order breaks the tie
+    w.call_at(SIM_EPOCH + 1.0, lambda: fired.append(("c", w.monotonic())))
+    w.advance(5.0)
+    # callbacks ran AT their instants, not at the jump target
+    assert fired == [("a", SIM_EPOCH + 1.0), ("c", SIM_EPOCH + 1.0),
+                     ("b", SIM_EPOCH + 2.0)]
+    assert w.monotonic() == SIM_EPOCH + 5.0
+
+
+def test_time_limit_is_a_typed_error_not_a_hang():
+    w = SimWorld(seed=0, time_limit_s=5.0)
+    w.advance(4.0)
+    with pytest.raises(SimTimeLimitExceeded) as ei:
+        w.advance(10.0)
+    assert ei.value.limit_s == 5.0
+    assert ei.value.now > SIM_EPOCH + 5.0
+
+
+def test_trace_digest_is_field_order_independent():
+    a, b = SimWorld(seed=0), SimWorld(seed=0)
+    a.record("x", one=1, two=2)
+    b.record("x", two=2, one=1)
+    assert a.digest() == b.digest()
+    b.record("y")
+    assert a.digest() != b.digest()
+
+
+# ----------------------------------------------------------- the world seam
+
+
+def test_use_installs_and_restores_even_on_error():
+    w = SimWorld(seed=0)
+    assert isinstance(_world.current(), RealWorld)
+    with pytest.raises(RuntimeError):
+        with _world.use(w):
+            assert _world.current() is w
+            assert _world.time() == SIM_EPOCH
+            raise RuntimeError("boom")
+    assert isinstance(_world.current(), RealWorld)
+
+
+def test_retry_backoff_sleeps_advance_simulated_time():
+    w = SimWorld(seed=0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    with _world.use(w):
+        # default sleep/clock resolve through the seam per call
+        pol = RetryPolicy(attempts=4, backoff=2.0, multiplier=2.0,
+                          jitter=0.0, seed=0, name="simtest")
+        t0 = time.perf_counter()
+        assert pol.call(flaky) == "ok"
+        wall = time.perf_counter() - t0
+    assert calls["n"] == 3
+    assert w.elapsed == 6.0  # 2.0 + 4.0, absorbed by the sim
+    assert w.sleeps == 2
+    assert wall < 1.0
+
+
+def test_fault_delay_action_runs_on_the_sim_clock():
+    w = SimWorld(seed=0)
+    faults.inject("ps.pull", action="delay", value=7.5)
+    with _world.use(w):
+        t0 = time.perf_counter()
+        assert faults.fault_point("ps.pull", "payload") == "payload"
+        wall = time.perf_counter() - t0
+    assert w.elapsed == 7.5
+    assert wall < 1.0
+
+
+def test_chaos_horizon_s_judged_by_the_sim_clock():
+    w = SimWorld(seed=0)
+    with _world.use(w):
+        specs = faults.chaos_schedule(seed=7, rate=1.0,
+                                      points=("ps.pull",),
+                                      horizon_s=10.0)
+        (spec,) = specs
+        assert 0.0 <= spec.at_s < 10.0
+        # before the drawn instant: not covered, at any call count
+        assert not spec.covers(0)
+        w.advance(spec.at_s + 0.001)
+        assert spec.covers(0)
+        spec.fired += 1
+        assert not spec.covers(1)  # times=1 spent
+
+
+def test_chaos_horizon_s_schedule_pure_and_rate_stable():
+    # pure function of its arguments: same args, same schedule
+    a = faults.chaos_schedule(seed=11, rate=1.0, horizon=20,
+                              horizon_s=30.0)
+    b = faults.chaos_schedule(seed=11, rate=1.0, horizon=20,
+                              horizon_s=30.0)
+    assert [(s.point, s.at, s.at_s, s.exc) for s in a] \
+        == [(s.point, s.at, s.at_s, s.exc) for s in b]
+    assert all(s.at_s is not None for s in a)
+    # tightening the rate only removes firings — the survivors keep
+    # their exact instants (draws are consumed unconditionally)
+    full = {s.point: (s.at, s.at_s, s.exc) for s in a}
+    tight = faults.chaos_schedule(seed=11, rate=0.3, horizon=20,
+                                  horizon_s=30.0)
+    assert 0 < len(tight) < len(a)
+    assert all(full[s.point] == (s.at, s.at_s, s.exc) for s in tight)
+    # without horizon_s no time instants are drawn at all
+    assert all(s.at_s is None
+               for s in faults.chaos_schedule(seed=11, rate=1.0,
+                                              horizon=20))
+
+
+# ------------------------------------------------------------- scenarios
+
+
+def test_seeded_replay_is_bit_identical():
+    one = run_scenario("partition_heal", seed=3, hosts=8)
+    two = run_scenario("partition_heal", seed=3, hosts=8)
+    assert one["digest"] == two["digest"]
+    assert one["trace_len"] == two["trace_len"] > 0
+    assert one["sim_elapsed_s"] == two["sim_elapsed_s"]
+    other = run_scenario("partition_heal", seed=4, hosts=8)
+    assert other["digest"] != one["digest"]
+
+
+def test_unknown_scenario_is_a_value_error():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        run_scenario("nope")
+
+
+def test_runner_time_limit_trips_typed():
+    with pytest.raises(SimTimeLimitExceeded):
+        run_scenario("partition_heal", seed=0, hosts=8,
+                     time_limit_s=0.001)
+
+
+def test_ps_churn_small():
+    res = run_scenario("ps_churn", seed=1, hosts=40)
+    assert res["hosts"] == 40
+    assert res["killed"] >= 4  # >= 10% of the swarm
+    assert res["reaped"] >= res["killed"]
+    assert res["accuracy"] >= 0.80
+    assert res["commits"] == 40 * res["steps_per_host"]
+
+
+def test_partition_heal_small():
+    res = run_scenario("partition_heal", seed=2, hosts=12)
+    assert res["typed_faults"] > 0  # the partition was FELT, then healed
+    assert res["accuracy"] >= 0.80
+
+
+def test_preemption_storm_small():
+    res = run_scenario("preemption_storm", seed=5, hosts=12)
+    assert res["completed"] + res["crash_loops"] == 12
+
+
+def test_relaunch_waves(tmp_path):
+    res = run_scenario("relaunch_waves", seed=0, hosts=5,
+                       workdir=str(tmp_path))
+    assert res["waves"] >= 2
+    assert res["final_world"] == 4  # the permanent loss was dropped
+
+
+def test_gc_race_small(tmp_path):
+    res = run_scenario("gc_race", seed=6, hosts=16,
+                       workdir=str(tmp_path))
+    assert res["surviving"] == res["keep"]
+    assert res["pruned"] > 0
+
+
+def test_cli_last_stdout_line_is_the_json_contract():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("DK_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (REPO + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dist_keras_tpu.sim",
+         "--scenario", "partition_heal", "--hosts", "8", "--seed", "0"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["passed"] is True
+    (s,) = rec["scenarios"]
+    assert s["scenario"] == "partition_heal"
+    assert len(s["digest"]) == 64
+
+
+def test_scenario_registry_matches_cli_choices():
+    assert SCENARIOS.keys() == {"ps_churn", "partition_heal",
+                                "preemption_storm", "relaunch_waves",
+                                "gc_race"}
