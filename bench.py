@@ -38,6 +38,10 @@ in run order:
    (``dist_keras_tpu.serving``), in a CPU-pinned subprocess so it
    still measures when the device probe times out (r05's all-null
    record); also run in the backend-unresponsive early-exit path.
+   The router row rides the same mechanics: /predict p50/p99 DIRECT
+   against one backend vs ROUTED through ``RouterServer`` over two,
+   plus the worst single-request latency while one backend dies
+   mid-stream (the sibling-retry failover blip).
 9. Checkpoint-manifest overhead — ``Checkpointer.save`` with vs
    without ``DK_CKPT_VERIFY`` (integrity manifests) + raw SHA-256
    throughput, CPU-pinned subprocess; also run in the
@@ -702,6 +706,130 @@ def bench_serving(peak=None, timeout_s=300):
         "serving_cpu_offered_load",
         argv=["-m", "dist_keras_tpu.serving.bench",
               "--qps", "400", "--seconds", "4"],
+        timeout_s=timeout_s)
+
+
+# The router bench worker: the same single-row /predict measured
+# DIRECT against one backend vs ROUTED through a RouterServer over two
+# (the fabric hop's overhead), then a continuous routed stream with one
+# backend dying mid-flight — the failover "blip" is the worst
+# single-request latency while the router burns its sibling retry and
+# evicts (every request still 200: the typed-503 path never fires with
+# a live sibling).  All in-process HTTP over loopback, CPU-pinned.
+_ROUTER_BENCH_WORKER = r"""
+import json, os, sys, threading, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import urllib.request
+import numpy as np
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.serving import (
+    RouterServer, ServingEngine, ServingServer)
+
+rng = np.random.default_rng(0)
+rows = rng.normal(size=(8, 4)).astype(np.float32)
+body = json.dumps({"rows": rows[:1].tolist()}).encode("utf-8")
+
+
+def make_backend():
+    eng = ServingEngine(mnist_mlp(hidden=(8,), input_dim=4,
+                                  num_classes=3),
+                        replicas=1, batch_ladder=(1, 8),
+                        max_latency_s=0.001, max_queue=1024)
+    for r in (1, 8):
+        eng.predict(rows[:r], timeout_s=120)  # warm the jit ladder
+    srv = ServingServer(eng, port=0)
+    srv.start()
+    return srv
+
+
+def post(addr, n, timeout=15):
+    lats, codes = [], []
+    for _ in range(n):
+        req = urllib.request.Request(
+            "http://%s/predict" % addr, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                resp.read()
+                codes.append(resp.status)
+        except Exception:
+            codes.append(-1)
+        lats.append((time.perf_counter() - t0) * 1000.0)
+    return lats, codes
+
+
+def pct(lats, q):
+    return round(float(np.percentile(np.asarray(lats), q)), 3)
+
+
+N = 150
+b0, b1 = make_backend(), make_backend()
+a0 = "%s:%d" % b0.address
+a1 = "%s:%d" % b1.address
+post(a0, 20)                                   # connection warmup
+direct, dcodes = post(a0, N)
+
+router = RouterServer([a0, a1], port=0, probe_s=0.1,
+                      forward_timeout_s=10.0, fail_threshold=2,
+                      stale_s=1.0, readmit_checks=2)
+ra = "%s:%d" % router.start()
+time.sleep(0.3)                                # first probe rounds
+post(ra, 20)
+routed, rcodes = post(ra, N)
+
+blat, bcodes = [], []
+stop = threading.Event()
+
+
+def blip_load():
+    while not stop.is_set():
+        lat, c = post(ra, 1)
+        blat.extend(lat)
+        bcodes.extend(c)
+
+
+t = threading.Thread(target=blip_load)
+t.start()
+time.sleep(0.5)
+b0._stop_listener()                  # abrupt death: connect refused
+time.sleep(1.0)                      # retry + evict + steady sibling
+stop.set()
+t.join(timeout=60)
+
+router.close()
+b1.close()
+print(json.dumps({
+    "requests": N,
+    "direct_p50_ms": pct(direct, 50),
+    "direct_p99_ms": pct(direct, 99),
+    "routed_p50_ms": pct(routed, 50),
+    "routed_p99_ms": pct(routed, 99),
+    "routed_over_direct_p50": round(pct(routed, 50)
+                                    / max(pct(direct, 50), 1e-9), 3),
+    "direct_errors": sum(1 for c in dcodes if c != 200),
+    "routed_errors": sum(1 for c in rcodes if c != 200),
+    "failover_requests": len(blat),
+    "failover_non200": sum(1 for c in bcodes if c != 200),
+    "failover_blip_ms": pct(blat, 100) if blat else None,
+}))
+"""
+
+
+def bench_router(peak=None, timeout_s=300):
+    """Serving-fabric router row (``router_overhead``): p50/p99 of the
+    same single-row ``/predict`` measured DIRECT against one backend vs
+    ROUTED through :class:`RouterServer` over two, plus the worst-case
+    single-request latency while one backend dies mid-stream (the
+    sibling-retry failover blip, expected zero non-200s).  CPU-pinned
+    subprocess like every host-side row, so it also measures in the
+    backend-unresponsive early-exit path.  No reference counterpart ->
+    ``vs_baseline`` stays null."""
+    return _run_cpu_worker(
+        "router_overhead", source=_ROUTER_BENCH_WORKER,
+        strip_prefixes=("DK_SERVE", "DK_ROUTE", "DK_COORD"),
         timeout_s=timeout_s)
 
 
@@ -1426,6 +1554,8 @@ def main():
         # wedged backend — the round still records real numbers
         for fn, fallback_name in ((bench_serving,
                                    "serving_cpu_offered_load"),
+                                  (bench_router,
+                                   "router_overhead"),
                                   (bench_ckpt_manifest,
                                    "ckpt_manifest_overhead"),
                                   (bench_ckpt_async_save,
@@ -1469,7 +1599,8 @@ def main():
     for fn in (bench_adag_mnist_cnn, bench_single_mnist_mlp,
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
-               bench_adag_streamed, bench_serving, bench_ckpt_manifest,
+               bench_adag_streamed, bench_serving, bench_router,
+               bench_ckpt_manifest,
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,
                bench_comm_overlap, bench_ps_compress,

@@ -47,6 +47,11 @@ KNOWN_THREAD_ROOTS = {
     "serve.reload_watcher": "serving/reload.py:CheckpointWatcher._loop",
     "serve.http": "serving/server.py:ServingServer.serve_forever",
     "serve.http_handler": "~serving/server.py:_Handler.*",
+    # serving router tier + autoscaler
+    "route.http": "serving/router.py:RouterServer.serve_forever",
+    "route.http_handler": "~serving/router.py:_Handler.*",
+    "route.health": "serving/router.py:RouterServer._health_loop",
+    "serve.autoscaler": "serving/autoscale.py:ReplicaAutoscaler._loop",
     # coordination plane
     "coord.deadline": "resilience/coordination.py:with_deadline.run",
     "coord.heartbeat": "resilience/coordination.py:Heartbeat._loop",

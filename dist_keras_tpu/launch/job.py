@@ -112,9 +112,9 @@ class Job:
                  remote_root="~/jobs", python="python3", dry_run=False,
                  retries=2, retry_backoff=0.5, launch_retries=0,
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
-                 serve_port=None, supervise=None, metrics_port=None,
-                 obs_sample_s=None, trace_id=None, ps_addr=None,
-                 ps_window=None, runner=None):
+                 serve_port=None, route_port=None, supervise=None,
+                 metrics_port=None, obs_sample_s=None, trace_id=None,
+                 ps_addr=None, ps_window=None, runner=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
         # from a JSON manifest — reject anything shell-/path-unsafe
@@ -193,6 +193,17 @@ class Job:
         # the same operator-chosen port on every host — one launch-config
         # knob turns a training job descriptor into a serving-job one
         self.serve_port = None if serve_port is None else int(serve_port)
+        # route_port: the serving-FABRIC knob on top of serve_port.
+        # When set (requires serve_port), every host's env additionally
+        # gets DK_ROUTE_PORT plus DK_ROUTE_BACKENDS — the full pod's
+        # host:serve_port list — so a router entrypoint
+        # (python -m dist_keras_tpu.serving.router) on any host fronts
+        # the whole pod, and the supervisor's elastic shrink naturally
+        # narrows the exported backend list on the next relaunch wave.
+        if route_port is not None and serve_port is None:
+            raise ValueError("route_port requires serve_port (the "
+                             "backends the router would front)")
+        self.route_port = None if route_port is None else int(route_port)
         # metrics_port: when set, every host's env gets DK_METRICS_PORT
         # and its training/serving process brings up the standalone
         # Prometheus exporter (observability.prometheus) on that port —
@@ -368,6 +379,14 @@ class Job:
         if self.serve_port is not None:
             # serving plane: ServingServer(port=None) binds this
             env["DK_SERVE_PORT"] = str(self.serve_port)
+        if self.route_port is not None:
+            # serving fabric: RouterServer(port=None) binds this, and
+            # the backend list is the CURRENT pod (self.hosts shrinks
+            # under supervise_run's elastic resize, so a relaunched
+            # router fronts exactly the surviving hosts)
+            env["DK_ROUTE_PORT"] = str(self.route_port)
+            env["DK_ROUTE_BACKENDS"] = ",".join(
+                f"{h}:{self.serve_port}" for h in self.hosts)
         if self.metrics_port is not None:
             # scrape plane: the per-host Prometheus exporter binds this
             env["DK_METRICS_PORT"] = str(self.metrics_port)

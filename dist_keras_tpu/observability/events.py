@@ -109,6 +109,10 @@ KNOWN_EVENTS = (
     "serve_reload", "serve_reload_error", "reload_skipped_corrupt",
     "serve_listen", "serve_drain_begin", "serve_drain_signal",
     "serve_drain",
+    # serving router + autoscaler (serving/router.py,
+    # serving/autoscale.py, serving/reload.py)
+    "route_evict", "route_readmit", "route_cutover",
+    "autoscale_resize",
     # parameter-server training mode (ps/)
     "ps_pull", "ps_commit", "ps_stale_scaled",
     "ps_worker_join", "ps_worker_lapse",

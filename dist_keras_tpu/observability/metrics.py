@@ -191,6 +191,17 @@ KNOWN_METRICS = {
     "serve.reload.errors": "counter",
     "serve.pending": "gauge",
     "serve.predict_s": "histogram",
+    # serving router tier (serving/router.py, serving/reload.py,
+    # serving/autoscale.py)
+    "route.requests": "counter",
+    "route.errors": "counter",
+    "route.evictions": "counter",
+    "route.readmissions": "counter",
+    "route.cutovers": "counter",
+    "route.backends_live": "gauge",
+    "route.forward_s": "histogram",
+    "autoscale.resizes": "counter",
+    "autoscale.replicas": "gauge",
     # parameter-server training mode (ps/server.py)
     "ps.pulls": "counter",
     "ps.commits": "counter",

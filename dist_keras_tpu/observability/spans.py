@@ -75,6 +75,9 @@ KNOWN_SPANS = (
     # sending its traceparent header — the gate's client worker does)
     "serve.request", "serve.batch", "serve.queue_wait", "serve.exec",
     "serve.reload", "serve.client",
+    # router forward hop (serving/router.py — parent of the backend's
+    # serve.request via the propagated traceparent header)
+    "route.forward",
     # parameter-server commit apply (ps/server.py)
     "ps.commit",
     # perf phases under an open device trace (observability/perf.py)

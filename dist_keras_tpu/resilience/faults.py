@@ -129,6 +129,10 @@ KNOWN_POINTS = (
     "reshard.load", "reshard.scatter",
     "ps.pull", "ps.commit", "ps.join", "ps.encode",
     "comm.merge",
+    # serving router (serving/router.py) — appended last: seeded chaos
+    # schedules index into this tuple, order is part of the replay
+    # contract
+    "route.forward", "route.health",
 )
 
 

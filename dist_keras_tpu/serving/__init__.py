@@ -1,5 +1,6 @@
 """Online serving subsystem — dynamic batching, replica scheduling,
-hot checkpoint reload, HTTP front end, graceful drain.
+hot checkpoint reload, HTTP front end, multi-host routing, graceful
+drain.
 
 The repo's offline ``ModelPredictor`` and pull-based
 ``StreamingPredictor`` answer "run the model over this data"; this
@@ -10,14 +11,38 @@ newly promoted checkpoints in with zero dropped requests, and
 :class:`ServingServer` is the stdlib HTTP boundary with typed
 backpressure and SIGTERM-drain via ``resilience.preemption``.
 
-See the README "Serving" section for endpoints, env knobs and drain
-semantics; ``examples/serving.py`` is the runnable demo;
-``python -m dist_keras_tpu.serving.bench`` the offered-load benchmark.
+On top of the per-host stack, the serving FABRIC (round 21):
+:class:`RouterServer` spreads ``POST /predict`` across hosts by their
+``/metricsz`` queue depth with evidence-based eviction/re-admission
+(:class:`BackendPool` is the HTTP-free policy core the simulator
+drives), :class:`BlueGreenEngine` turns a reload into one atomic
+traffic cutover between two engines sharing devices, and
+:class:`ReplicaAutoscaler` closes the ``QueueDepthGrowth`` alerting
+loop into ``engine.resize`` actuation with hysteresis.
+
+See the README "Serving" and "Serving fabric" sections for endpoints,
+env knobs, failure matrix and drain semantics; ``examples/serving.py``
+is the runnable demo; ``python -m dist_keras_tpu.serving.bench`` the
+offered-load benchmark.
 """
 
+from dist_keras_tpu.serving.autoscale import ReplicaAutoscaler
 from dist_keras_tpu.serving.engine import Overloaded, ServingEngine
-from dist_keras_tpu.serving.reload import CheckpointWatcher
+from dist_keras_tpu.serving.reload import (
+    BlueGreenEngine,
+    CheckpointWatcher,
+)
+from dist_keras_tpu.serving.router import (
+    BackendPool,
+    ForwardError,
+    NoBackends,
+    RouterServer,
+    default_route_port,
+)
 from dist_keras_tpu.serving.server import ServingServer, default_port
 
 __all__ = ["ServingEngine", "Overloaded", "CheckpointWatcher",
-           "ServingServer", "default_port"]
+           "ServingServer", "default_port",
+           "RouterServer", "BackendPool", "ForwardError", "NoBackends",
+           "BlueGreenEngine", "ReplicaAutoscaler",
+           "default_route_port"]

@@ -179,6 +179,10 @@ class ServingEngine:
         n = int(replicas) if replicas is not None else len(devices)
         if n < 1:
             raise ValueError(f"replicas={replicas} must be >= 1")
+        # kept for resize(): grown replicas share the same device list
+        # round-robin, exactly like construction
+        self._devices = list(devices) if devices else []
+        self._next_replica_index = n
         self._replicas = [
             _Replica(i, devices[i % len(devices)] if devices else None,
                      self._host_params)
@@ -388,7 +392,14 @@ class ServingEngine:
                 with spans.span("serve.batch", rung=rung, n=n):
                     events.emit("serve_batch_flush", rung=rung, n=n,
                                 fill_ratio=n / rung)
-                    self._pick_replica().inbox.put((x, take))
+                    # pick + put UNDER the admission lock: resize()
+                    # retires replicas under the same lock (truncate,
+                    # then sentinel), so a batch can never be dispatched
+                    # into an inbox whose replica already saw its
+                    # sentinel — inbox.put never blocks (unbounded
+                    # queue), so holding _cond across it is cheap
+                    with self._cond:
+                        self._pick_replica().inbox.put((x, take))
 
     # -- replicas -------------------------------------------------------
     def _replica_loop(self, rep):
@@ -471,6 +482,55 @@ class ServingEngine:
         metrics.counter("serve.reloads").inc()
         events.emit("serve_reload", step=step,
                     replicas=len(self._replicas))
+
+    # -- elastic replica set --------------------------------------------
+    def resize(self, n):
+        """Grow or shrink the replica set in place — the autoscaler's
+        actuation seam.  Grow: new replicas share the construction
+        device list round-robin and start on the CURRENT params.
+        Shrink: a retired replica's sentinel is posted under the same
+        lock the batcher dispatches under, so it lands strictly AFTER
+        any batch already routed there — the retiree delivers its whole
+        backlog, then exits (nothing admitted is ever dropped).  -> the
+        new replica count.  Raises :class:`Overloaded` on a draining or
+        stopped engine (the replica set is frozen once shutdown began).
+        """
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"resize({n}): must keep >= 1 replica")
+        started = []
+        with self._cond:
+            if self._stopped or self._draining:
+                raise Overloaded(
+                    "stopped" if self._stopped else "draining")
+            cur = len(self._replicas)
+            if n < cur:
+                retired = self._replicas[n:]
+                del self._replicas[n:]
+                self._rr = 0
+                for rep in retired:
+                    rep.inbox.put(None)
+            elif n > cur:
+                devs = self._devices
+                for _ in range(n - cur):
+                    idx = self._next_replica_index
+                    self._next_replica_index += 1
+                    rep = _Replica(
+                        idx, devs[idx % len(devs)] if devs else None,
+                        self._host_params)
+                    self._replicas.append(rep)
+                    t = threading.Thread(
+                        target=self._replica_loop, args=(rep,),
+                        daemon=True, name=f"dk-serve-replica-{idx}")
+                    # the full thread list (retirees included) so
+                    # _shutdown_threads joins every thread ever started;
+                    # a retiree's thread exits on its sentinel and joins
+                    # instantly
+                    self._replica_threads.append(t)
+                    started.append(t)
+        for t in started:  # start outside the lock; inboxes buffer
+            t.start()
+        return n
 
     # -- lifecycle ------------------------------------------------------
     def drain(self, timeout_s=None):

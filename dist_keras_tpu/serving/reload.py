@@ -70,6 +70,116 @@ from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.resilience.retry import RetryPolicy
 
 
+class BlueGreenEngine:
+    """Two engines, one traffic pointer — reload as an atomic cutover.
+
+    :meth:`ServingEngine.set_params` already hot-swaps params with zero
+    dropped requests, but the swap is gradual per replica and the new
+    params serve from engines whose queues still hold old-params work.
+    Blue/green makes the rollout a single atomic TRAFFIC decision
+    instead: two :class:`~.engine.ServingEngine` instances share the
+    same devices (the standby idles, so the device cost is memory, not
+    compute); ``set_params`` loads the new params into the STANDBY,
+    then flips the active index — one reference assignment, atomic
+    under the GIL.  Requests admitted before the flip drain on the old
+    params inside the old engine (its no-drop contract is untouched);
+    requests after the flip land on the new ones.  Nothing is ever
+    in-between, and a bad load never touches the serving color.
+
+    The class quacks like a single engine everywhere the serving stack
+    cares (``submit`` / ``predict`` / ``set_params`` / ``resize`` /
+    ``stats`` / ``drain`` / ``close`` / ``draining`` / ``running``),
+    so :class:`ServingServer`, :class:`CheckpointWatcher`, and the
+    autoscaler compose with it unchanged.  Each cutover emits
+    ``route_cutover`` + the ``route.cutovers`` counter.
+    """
+
+    def __init__(self, make_engine):
+        """``make_engine`` builds one engine (called twice — the
+        factory form keeps the two engines' construction identical
+        without this class knowing the model/ladder/device args)."""
+        self._engines = [make_engine(), make_engine()]
+        self._active_idx = 0
+        self._lock = threading.Lock()  # serializes cutovers, not reads
+        self.cutovers = 0
+
+    @property
+    def active(self):
+        return self._engines[self._active_idx]
+
+    @property
+    def standby(self):
+        return self._engines[1 - self._active_idx]
+
+    # -- serving surface (active color) ---------------------------------
+    def submit(self, row):
+        # one atomic read of the index: a request races the flip into
+        # exactly one color, and whichever engine admitted it delivers
+        # it (the old color keeps draining after a flip)
+        return self._engines[self._active_idx].submit(row)
+
+    def predict(self, rows, timeout_s=None):
+        return self._engines[self._active_idx].predict(
+            rows, timeout_s=timeout_s)
+
+    # -- rollout --------------------------------------------------------
+    def set_params(self, state, step=None):
+        """Load ``state`` into the standby, then atomically cut traffic
+        over to it.  The previous active keeps its queue and finishes
+        every admitted request on the params they were admitted under,
+        then becomes the next rollout's standby."""
+        with self._lock:
+            standby_idx = 1 - self._active_idx
+            self._engines[standby_idx].set_params(state, step=step)
+            self._active_idx = standby_idx  # THE cutover instant
+            self.cutovers += 1
+        metrics.counter("route.cutovers").inc()
+        events.emit("route_cutover", step=step,
+                    active_engine=standby_idx, cutovers=self.cutovers)
+
+    def resize(self, n):
+        """Fan to both colors: the standby must already be at size when
+        it becomes active mid-incident."""
+        with self._lock:
+            for e in self._engines:
+                e.resize(n)
+        return n
+
+    # -- lifecycle / introspection --------------------------------------
+    def drain(self, timeout_s=None):
+        outs = [e.drain(timeout_s=timeout_s) for e in self._engines]
+        a = outs[self._active_idx]
+        return {**a, "standby_delivered":
+                outs[1 - self._active_idx]["delivered"]}
+
+    def close(self, drain=True, timeout_s=None):
+        for e in self._engines:
+            e.close(drain=drain, timeout_s=timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def draining(self):
+        return self._engines[self._active_idx].draining
+
+    @property
+    def running(self):
+        return self._engines[self._active_idx].running
+
+    def stats(self):
+        st = self._engines[self._active_idx].stats()
+        st["cutovers"] = self.cutovers
+        st["active_engine"] = self._active_idx
+        st["standby_outstanding"] = \
+            self._engines[1 - self._active_idx].stats()["outstanding"]
+        return st
+
+
 class CheckpointWatcher:
     """Poll a ``Checkpointer`` for newly promoted steps and hot-swap
     them into a :class:`~dist_keras_tpu.serving.engine.ServingEngine`.

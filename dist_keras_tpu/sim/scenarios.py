@@ -29,6 +29,10 @@ The scenarios:
 - ``gc_race`` — many writers mirroring differential checkpoints into
   one in-memory store interleaved with ``prune_remote``: after every
   prune, every surviving ``COMPLETE`` step is fully fetchable.
+- ``router_failover`` — the serving router's ``BackendPool`` under a
+  load spike: a backend killed mid-spike is evicted within the stale
+  window (connect-failure + heartbeat evidence), re-admitted after
+  healing, zero silent drops and zero placements on an evicted host.
 
 Scenario outcomes are *asserted* here (a violated invariant raises
 :class:`ScenarioFailed`), so a scenario that returns IS its own green
@@ -577,10 +581,176 @@ def gc_race(world, hosts=None, workdir=None):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------
+# serving-router failover under a load spike
+# ---------------------------------------------------------------------
+
+def router_failover(world, hosts=None, workdir=None):
+    """The serving router's :class:`BackendPool` policy core driven on
+    SIM time against modeled backends: a seeded load spike, one
+    backend killed mid-spike (connect failures + heartbeat gone dark),
+    evicted within the stale window, healed later and re-admitted
+    after its hysteresis streak.  Every request is either placed on a
+    live backend and eventually served, or typed-rejected — zero
+    silent drops, zero placements on an evicted backend."""
+    from dist_keras_tpu.serving.router import BackendPool
+
+    hosts = 8 if hosts is None else max(3, int(hosts))
+    rng = world.rng
+    own = workdir is None
+    if own:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="dk-sim-router-")
+    try:
+        coord = os.path.join(workdir, "coord")
+        hb = os.path.join(coord, "hb")
+        os.makedirs(hb, exist_ok=True)
+        addrs = [f"sim{r}:9000" for r in range(hosts)]
+        probe_s, stale_s = 0.5, 2.0
+        pool = BackendPool(addrs, fail_threshold=3, stale_s=stale_s,
+                           readmit_checks=2, coord_dir=coord,
+                           world_size=hosts)
+        backends = {a: {"up": True, "depth": 0, "rank": r}
+                    for r, a in enumerate(addrs)}
+        serve_per_tick = 3   # per-backend service rate (reqs / tick)
+
+        def _stamp(rank):
+            path = os.path.join(hb, f"rank_{rank}")
+            with open(path, "w") as f:
+                f.write(repr(world.time()))
+            t = world.time()
+            os.utime(path, (t, t))
+
+        for r in range(hosts):
+            _stamp(r)
+
+        def beat():  # sim-time heartbeats for every live backend
+            for b in backends.values():
+                if b["up"]:
+                    _stamp(b["rank"])
+            world.call_later(0.5, beat)
+
+        world.call_later(0.5, beat)
+
+        victim = addrs[rng.randrange(hosts)]
+        t_kill, t_heal, t_end = 4.0, 12.0, 20.0
+        tick = 0.1
+        placed = completed = rejected = 0
+        picked_dead_after_evict = 0
+        kill_at = evict_after = readmit_at = None
+        next_probe = 0.0
+
+        while world.elapsed < t_end:
+            now = world.elapsed
+            if kill_at is None and now >= t_kill:
+                backends[victim]["up"] = False
+                kill_at = now
+                world.record("kill", backend=victim)
+            if (kill_at is not None and now >= t_heal
+                    and not backends[victim]["up"]):
+                backends[victim]["up"] = True
+                world.record("heal", backend=victim)
+            if now >= next_probe:  # the router's health-probe round
+                for a, b in backends.items():
+                    if b["up"]:
+                        pool.note_probe(a, True, depth=b["depth"])
+                    else:
+                        pool.note_probe(a, False)
+                pool.sweep()
+                next_probe = now + probe_s
+                snap = {s["addr"]: s for s in pool.snapshot()}
+                if (evict_after is None and kill_at is not None
+                        and not snap[victim]["live"]):
+                    evict_after = now - kill_at
+                    world.record(
+                        "evicted", backend=victim,
+                        reason=snap[victim]["evicted_reason"],
+                        after_s=round(evict_after, 9))
+                if (evict_after is not None and readmit_at is None
+                        and now >= t_heal and snap[victim]["live"]):
+                    readmit_at = now
+                    world.record("readmitted", backend=victim,
+                                 at_s=round(now, 9))
+            # offered load: a spike window covering the kill instant
+            spike = 2.0 <= now <= 9.0
+            for _ in range(rng.randrange(8, 12) if spike
+                           else rng.randrange(2, 5)):
+                excluded = set()
+                for _attempt in range(2):  # router: 1 sibling retry
+                    a = pool.pick(exclude=excluded)
+                    if a is None:
+                        rejected += 1  # typed 503: no live backend
+                        break
+                    if evict_after is not None and a == victim \
+                            and not backends[a]["up"]:
+                        picked_dead_after_evict += 1
+                    if backends[a]["up"]:
+                        backends[a]["depth"] += 1
+                        pool.note_forward(a, True)
+                        placed += 1
+                        break
+                    # connect failure: evidence + sibling retry —
+                    # exactly RouterServer.forward's policy
+                    pool.note_forward(a, False)
+                    excluded.add(a)
+                else:
+                    rejected += 1  # both attempts burned: typed 503
+            for b in backends.values():  # backends serve their queues
+                if b["up"] and b["depth"]:
+                    served = min(b["depth"], serve_per_tick)
+                    b["depth"] -= served
+                    completed += served
+            world.advance(tick)
+
+        # drain: every placed request must complete (no silent loss)
+        for _ in range(200):
+            residual = sum(b["depth"] for b in backends.values())
+            if not residual:
+                break
+            for b in backends.values():
+                if b["up"] and b["depth"]:
+                    served = min(b["depth"], serve_per_tick)
+                    b["depth"] -= served
+                    completed += served
+            world.advance(tick)
+
+        _require(evict_after is not None,
+                 "the killed backend was never evicted")
+        _require(evict_after <= stale_s + 2 * probe_s + 1e-9,
+                 f"eviction took {evict_after:.2f}s — outside the "
+                 f"stale window {stale_s}s + probe slack")
+        _require(readmit_at is not None,
+                 "the healed backend was never re-admitted")
+        _require(picked_dead_after_evict == 0,
+                 f"{picked_dead_after_evict} requests were routed to "
+                 "an evicted backend")
+        _require(completed == placed,
+                 f"dropped requests: placed {placed} != completed "
+                 f"{completed}")
+        _require(rejected < placed,
+                 f"rejected {rejected} >= placed {placed} — the pool "
+                 "shed more than it served")
+        _require(pool.evictions >= 1 and pool.readmissions >= 1,
+                 "pool counters missed the evict/readmit cycle")
+        return {"hosts": hosts, "victim": victim,
+                "evict_after_s": round(evict_after, 6),
+                "readmit_at_s": round(readmit_at, 6),
+                "placed": placed, "completed": completed,
+                "rejected": rejected,
+                "evictions": pool.evictions,
+                "readmissions": pool.readmissions,
+                "sleeps": world.sleeps}
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "ps_churn": ps_churn,
     "partition_heal": partition_heal,
     "preemption_storm": preemption_storm,
     "relaunch_waves": relaunch_waves,
     "gc_race": gc_race,
+    "router_failover": router_failover,
 }

@@ -264,6 +264,32 @@ _register("DK_SERVE_PORT", None, int, kind="port",
           doc="the port a launched serving job binds (exported per "
               "host by `launch.Job(serve_port=...)`)")
 
+# serving router tier (serving/router.py)
+_register("DK_ROUTE_PORT", None, int, kind="port",
+          doc="the port a launched `RouterServer(port=None)` binds "
+              "(exported per host by `launch.Job(route_port=...)`)")
+_register("DK_ROUTE_BACKENDS", None, str,
+          "comma-separated `host:port` list of backend serving hosts "
+          "the router spreads `POST /predict` across (exported per "
+          "host by `launch.Job(route_port=...)` from the pod's "
+          "serve ports)")
+_register("DK_ROUTE_PROBE_S", 0.5, float, kind="seconds",
+          doc="router health-probe cadence: how often the background "
+              "prober hits each backend's `/healthz` + `/metricsz` "
+              "and runs the eviction/re-admission sweep")
+_register("DK_ROUTE_STALE_S", 3.0, float, kind="seconds",
+          doc="a backend whose last good `/healthz` is older than "
+              "this is evicted from rotation (also the "
+              "`dead_peers_at` heartbeat staleness bound when the "
+              "router watches a coordination dir)")
+_register("DK_ROUTE_FAILS", 3, int,
+          "consecutive connect/forward failures that evict a backend "
+          "immediately, without waiting for the stale window")
+_register("DK_ROUTE_READMIT_CHECKS", 2, int,
+          "consecutive healthy probes a previously-evicted backend "
+          "must pass before it re-enters rotation (hysteresis — one "
+          "lucky probe never re-admits a flapping host)")
+
 # parameter-server training mode
 _register("DK_PS_ADDR", None, str,
           "`host:port` of the center-variable parameter server every "

@@ -734,6 +734,348 @@ raise Preempted(srv.preempted_signum)
 """
 
 
+# The router gate's worker (three modes, one script):
+#
+# - "fabric": two REAL backend serving subprocesses behind a
+#   RouterServer, client load with per-request traceparents, one
+#   backend SIGKILLed mid-load (evicted within the stale window, every
+#   client-visible failure a typed 503 + Retry-After, zero transport
+#   errors), then restarted on the same port and re-admitted; finally
+#   the shared DK_OBS_DIR event logs must show ONE stitched trace per
+#   request: client trace -> router route.forward -> backend
+#   serve.request -> replica serve.exec.
+# - "bluegreen": a BlueGreenEngine under continuous submit load across
+#   two set_params cutovers — zero lost requests, predictions flip.
+# - "autoscale": deterministic ReplicaAutoscaler ticks over a
+#   hand-fed serve.pending ring — a sustained ramp actuates up, noise
+#   holds still, calm scales down with hysteresis, floor/ceiling hold.
+_ROUTER_WORKER = r"""
+import os, sys, json, time, threading
+mode, work = sys.argv[1], sys.argv[2]
+if mode == "fabric":
+    # shared event-log dir BEFORE any dist_keras_tpu import: the
+    # router (rank 7) and both backends (ranks 0/1) write one
+    # per-rank JSONL each — the stitched-trace evidence
+    os.environ["DK_OBS_DIR"] = os.path.join(work, "obs")
+    os.environ["DK_COORD_RANK"] = "7"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %REPO%)
+import subprocess
+import urllib.error, urllib.request
+import numpy as np
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.serving import (
+    BlueGreenEngine, Overloaded, ReplicaAutoscaler, RouterServer,
+    ServingEngine, ServingServer)
+
+failures = []
+
+def check(cond, msg):
+    if not cond:
+        failures.append(msg)
+
+def finish(**detail):
+    print("ROUTER_RESULT " + json.dumps(
+        {"ok": not failures, "failures": failures, **detail}),
+        flush=True)
+    sys.exit(0 if not failures else 1)
+
+rng = np.random.default_rng(0)
+rows = rng.normal(size=(8, 4)).astype(np.float32)
+
+if mode == "fabric":
+    _BACKEND_SRC = '''
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.serving import ServingEngine, ServingServer
+
+port, port_file = int(sys.argv[1]), sys.argv[2]
+model = mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+eng = ServingEngine(model, replicas=1, batch_ladder=(1, 8),
+                    max_latency_s=0.001, max_queue=1024)
+rng = np.random.default_rng(0)
+rows = rng.normal(size=(8, 4)).astype(np.float32)
+for r in (1, 8):
+    eng.predict(rows[:r], timeout_s=120)  # warm the ladder pre-listen
+srv = ServingServer(eng, port=port)
+srv.start()
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(srv.address[1]))
+os.replace(port_file + ".tmp", port_file)  # port publish is atomic
+while True:
+    time.sleep(1)
+'''
+    bpath = os.path.join(work, "backend.py")
+    with open(bpath, "w") as f:
+        f.write(_BACKEND_SRC)
+
+    def spawn(rank, port, tag):
+        pf = os.path.join(work, "port_" + tag)
+        env = dict(os.environ)
+        env["DK_COORD_RANK"] = str(rank)
+        p = subprocess.Popen([sys.executable, bpath, str(port), pf],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL, env=env)
+        t0 = time.monotonic()
+        while not os.path.exists(pf):
+            if p.poll() is not None:
+                raise RuntimeError(
+                    "backend %d died rc=%s" % (rank, p.returncode))
+            if time.monotonic() - t0 > 180:
+                p.kill()
+                raise RuntimeError("backend %d startup timed out" % rank)
+            time.sleep(0.05)
+        with open(pf) as f:
+            return p, int(f.read())
+
+    PROBE_S, STALE_S = 0.25, 1.0
+    p0, port0 = spawn(0, 0, "b0")
+    p1, port1 = spawn(1, 0, "b1")
+    addr0 = "127.0.0.1:%d" % port0
+    srv = RouterServer(
+        [addr0, "127.0.0.1:%d" % port1], port=0, probe_s=PROBE_S,
+        forward_timeout_s=10.0, fail_threshold=3, stale_s=STALE_S,
+        readmit_checks=2)
+    host, rport = srv.start()
+
+    results = []          # (status, typed) per client request
+    client_traces = set()
+    stop = threading.Event()
+    body = json.dumps({"rows": rows[:1].tolist()}).encode("utf-8")
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            trace = format(0xABC0000 + i, "032x")
+            client_traces.add(trace)
+            req = urllib.request.Request(
+                "http://%s:%d/predict" % (host, rport), data=body,
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "traceparent":
+                         "00-%s-00000000000000ab-01" % trace})
+            try:
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    resp.read()
+                    results.append((resp.status, True))
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                typed = False
+                if e.code == 503:
+                    try:
+                        doc = json.loads(payload.decode("utf-8"))
+                        typed = ("error" in doc and
+                                 e.headers.get("Retry-After")
+                                 is not None)
+                    except ValueError:
+                        typed = False
+                results.append((e.code, typed))
+            except Exception:
+                # transport failure TO THE ROUTER: never acceptable
+                results.append((-1, False))
+            time.sleep(0.02)
+
+    loader = threading.Thread(target=load)
+    loader.start()
+    time.sleep(1.0)  # steady-state routed load over both backends
+
+    p0.kill()        # SIGKILL one backend mid-load
+    p0.wait()
+    t_kill = time.monotonic()
+    evicted = False
+    while time.monotonic() - t_kill < 10:
+        snap = {b["addr"]: b for b in srv.pool.snapshot()}
+        if not snap[addr0]["live"]:
+            evicted = True
+            break
+        time.sleep(0.02)
+    evict_s = time.monotonic() - t_kill
+    check(evicted, "SIGKILLed backend never evicted")
+    check(evict_s <= STALE_S + 2 * PROBE_S + 1.0,
+          "eviction took %.2fs (window %.2fs)"
+          % (evict_s, STALE_S + 2 * PROBE_S))
+    time.sleep(0.5)  # load keeps flowing on the survivor
+
+    p0b, _ = spawn(0, port0, "b0r")  # heal: same port, same pool addr
+    t_heal = time.monotonic()
+    while time.monotonic() - t_heal < 30 and srv.pool.live_count() < 2:
+        time.sleep(0.05)
+    check(srv.pool.live_count() == 2,
+          "healed backend never re-admitted")
+    time.sleep(0.7)  # routed traffic over the re-admitted pair
+    stop.set()
+    loader.join(timeout=60)
+
+    n200 = sum(1 for s, _ in results if s == 200)
+    untyped = [s for s, typed in results if s != 200 and not typed]
+    check(n200 >= 20, "too little load survived: %d x 200" % n200)
+    check(not untyped,
+          "client-visible errors beyond typed 503: %s" % untyped[:10])
+    check(srv.pool.evictions >= 1, "pool recorded no eviction")
+    check(srv.pool.readmissions >= 1, "pool recorded no re-admission")
+    srv.close()
+    for p in (p1, p0b):
+        p.terminate()
+        p.wait()
+
+    # stitched traces: one per request across router -> host -> replica
+    obs = os.environ["DK_OBS_DIR"]
+    recs = []
+    for fn in os.listdir(obs):
+        if fn.startswith("events-rank_") and fn.endswith(".jsonl"):
+            with open(os.path.join(obs, fn)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            recs.append(json.loads(line))
+                        except ValueError:
+                            pass  # torn tail line from the SIGKILL
+    route_fwd = {r["span_id"]: r["trace_id"] for r in recs
+                 if r.get("kind") == "span_end"
+                 and r.get("span") == "route.forward"}
+    check(len(route_fwd) >= n200,
+          "route.forward spans (%d) < 200s (%d)"
+          % (len(route_fwd), n200))
+    check(all(t in client_traces for t in route_fwd.values()),
+          "route.forward spans not on the callers' traces")
+    stitched = [r for r in recs if r.get("kind") == "span_end"
+                and r.get("span") == "serve.request"
+                and r.get("parent_id") in route_fwd
+                and r.get("trace_id") == route_fwd[r["parent_id"]]]
+    check(len(stitched) >= max(1, int(0.9 * n200)),
+          "stitched serve.request spans (%d) < 90%% of 200s (%d)"
+          % (len(stitched), n200))
+    exec_spans = [r for r in recs if r.get("kind") == "span_end"
+                  and r.get("span") == "serve.exec"
+                  and r.get("trace_id") in client_traces]
+    check(len(exec_spans) >= 1,
+          "no replica-stage span on a caller trace")
+    finish(evict_s=round(evict_s, 3), n200=n200,
+           n503_typed=sum(1 for s, t in results if s == 503 and t),
+           route_spans=len(route_fwd), stitched=len(stitched),
+           evictions=srv.pool.evictions,
+           readmissions=srv.pool.readmissions)
+
+if mode == "bluegreen":
+    models = []
+
+    def make_engine():
+        m = mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+        models.append(m)
+        return ServingEngine(m, replicas=1, batch_ladder=(1, 8),
+                             max_latency_s=0.001, max_queue=4096)
+
+    bg = BlueGreenEngine(make_engine)
+    for r in (1, 8):
+        bg.predict(rows[:r], timeout_s=120)  # warm the active color
+    base = bg.predict(rows[:4], timeout_s=60)
+
+    counts = {"submitted": 0, "delivered": 0, "errors": 0}
+    stop = threading.Event()
+
+    def load():
+        futs = []
+        while not stop.is_set():
+            try:
+                futs.append(bg.submit(rows[counts["submitted"] % 8]))
+                counts["submitted"] += 1
+            except Overloaded:
+                counts["errors"] += 1
+                break
+            time.sleep(0.001)
+        for f in futs:
+            try:
+                f.result(timeout=60)
+                counts["delivered"] += 1
+            except Exception:
+                counts["errors"] += 1
+
+    loader = threading.Thread(target=load)
+    loader.start()
+    time.sleep(0.3)
+    state1 = {"params": jax.tree.map(
+        lambda a: np.asarray(a) * 0.5, models[0].params)}
+    bg.set_params(state1, step=1)   # cutover 1 under load
+    time.sleep(0.3)
+    state2 = {"params": jax.tree.map(
+        lambda a: np.asarray(a) * 0.25, models[0].params)}
+    bg.set_params(state2, step=2)   # cutover 2 under load
+    time.sleep(0.3)
+    stop.set()
+    loader.join(timeout=120)
+
+    check(counts["submitted"] > 0, "no load ran")
+    check(counts["errors"] == 0, "requests lost: %s" % counts)
+    check(counts["delivered"] == counts["submitted"],
+          "cutover dropped admitted requests: %s" % counts)
+    check(bg.cutovers == 2, "cutovers=%d (want 2)" % bg.cutovers)
+    after = bg.predict(rows[:4], timeout_s=60)
+    check(not np.allclose(after, base),
+          "predictions did not flip across the cutover")
+    st = bg.stats()
+    check(st["standby_outstanding"] == 0,
+          "old color still holds work: %s" % st["standby_outstanding"])
+    bg.close()
+    finish(**counts, cutovers=bg.cutovers)
+
+# mode == "autoscale": deterministic ticks over a hand-fed ring
+from dist_keras_tpu.observability import timeseries
+
+model = mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+eng = ServingEngine(model, replicas=1, batch_ladder=(1, 8),
+                    max_latency_s=0.001, max_queue=1024)
+for r in (1, 8):
+    eng.predict(rows[:r], timeout_s=120)
+a = ReplicaAutoscaler(eng, floor=1, ceiling=3, depth_high=8.0,
+                      samples=4, clear_checks=3, cooldown_checks=1,
+                      step=1)
+ts = timeseries.series("serve.pending")
+for v in (1.0, 3.0, 6.0):   # fewer points than `samples`: no verdict
+    ts.append(v)
+    check(a.tick() is None, "scaled before enough evidence")
+ts.append(9.0)              # ramp [1,3,6,9]: grew, ends >= depth_high
+check(a.tick() == "up", "sustained ramp did not actuate")
+check(eng.stats()["replicas"] == 2, "resize(2) did not happen")
+ts.append(10.0)
+check(a.tick() is None, "cooldown tick not held")
+for v in (3.0, 7.0, 2.5, 6.0, 3.5, 7.5):   # noise: no ramp, not calm
+    ts.append(v)
+    check(a.tick() is None, "resized on noise at %s" % v)
+check(eng.stats()["replicas"] == 2, "noise moved the replica set")
+for v in (8.0, 9.0, 10.0, 11.0):   # second ramp, into the ceiling
+    ts.append(v)
+    a.tick()
+check(eng.stats()["replicas"] == 3, "second ramp missed the ceiling")
+ts.append(12.0)
+check(a.tick() is None and eng.stats()["replicas"] == 3,
+      "scaled past the ceiling")
+downs = []
+for v in (1.0, 1.0, 0.0, 1.0, 0.0, 0.0, 1.0, 0.0, 0.0):  # calm
+    ts.append(v)
+    downs.append(a.tick())
+check(downs.count("down") == 2 and eng.stats()["replicas"] == 1,
+      "calm hysteresis wrong: %s -> %d replicas"
+      % (downs, eng.stats()["replicas"]))
+for v in (0.0, 0.0, 0.0, 0.0):
+    ts.append(v)
+    check(a.tick() is None, "resized below the floor")
+check(eng.stats()["replicas"] == 1, "floor violated")
+check(a.resizes == 4, "resizes=%d (want 4)" % a.resizes)
+ok = eng.predict(rows[:4], timeout_s=60)   # the scaled engine serves
+check(ok.shape == (4, 3), "engine dead after resizes")
+eng.drain(timeout_s=60)
+finish(resizes=a.resizes, replicas=eng.stats()["replicas"])
+"""
+
+
 # The chaos gate's 2-process worker: the coordinated-preemption
 # choreography (votes, agreements, two-phase saves, barriers) driven
 # for several rounds under a SEEDED random fault schedule
@@ -2030,6 +2372,70 @@ def run_serving_gate(timeout=420):
     }
 
 
+def run_router_gate(timeout=420):
+    """-> gate record for the serving-fabric router tier (see
+    _ROUTER_WORKER): a SIGKILLed backend evicted within the stale
+    window with zero untyped client errors and re-admitted after
+    healing, one stitched router->host->replica trace per request,
+    blue/green cutover under load losing zero requests, and the
+    autoscaler actuating on a sustained ramp while holding still under
+    noise/hysteresis."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_route_gate_")
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_ROUTER_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_SERVE", "DK_ROUTE", "DK_ALERT"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    detail = {}
+    t0 = time.time()
+    try:
+        for mode in ("fabric", "bluegreen", "autoscale"):
+            p = subprocess.Popen([sys.executable, script, mode, work],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT,
+                                 env=base_env, text=True)
+            try:
+                out = p.communicate(timeout=timeout)[0]
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = p.communicate()[0]
+                failures.append(f"{mode}: HANG (killed at {timeout}s)")
+                continue
+            m = re.search(r"^ROUTER_RESULT (\{.*\})$", out, re.M)
+            if m:
+                doc = json.loads(m.group(1))
+                detail[mode] = {k: v for k, v in doc.items()
+                                if k not in ("ok", "failures")}
+                failures.extend(f"{mode}: " + f
+                                for f in doc.get("failures", []))
+                if p.returncode != 0 and not doc.get("failures"):
+                    failures.append(f"{mode}: rc={p.returncode}")
+            else:
+                failures.append(f"{mode}: no ROUTER_RESULT "
+                                f"(rc={p.returncode}): {out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "router",
+        "metric": "failover_readmit_stitched_bluegreen_autoscale",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "detail": detail,
+        "failures": failures,
+    }
+
+
 def _run_obs_pair(script, base_env, work, name, obs_dir, timeout):
     """Launch the 2-rank worker; -> (rcs, outs, rank-0 stats, hung)."""
     coord_dir = os.path.join(work, name, "coord")
@@ -3100,9 +3506,10 @@ def run_sim_gate(timeout=600):
     20): every scenario script green in one CLI run (1000-host PS
     churn with kills/rejoins + a healed partition, focused partition
     heal, preemption storm, elastic relaunch waves, checkpoint GC
-    races), the churn run under its 60s wall budget, and a second
-    seeded run of ``ps_churn`` replaying BIT-IDENTICALLY (trace digest
-    equality across two separate processes)."""
+    races, router failover under a load spike), the churn run under
+    its 60s wall budget, and second seeded runs of ``ps_churn`` AND
+    ``router_failover`` replaying BIT-IDENTICALLY (trace digest
+    equality across separate processes)."""
     t0 = time.time()
     failures = []
     detail = {}
@@ -3173,6 +3580,23 @@ def run_sim_gate(timeout=600):
                     "ps_churn replay diverged: "
                     f"{churn.get('digest', '')[:16]} != "
                     f"{replay.get('digest', '')[:16]}")
+        rf = next((r for r in doc.get("scenarios", [])
+                   if r.get("scenario") == "router_failover"), None)
+        if rf is None or "error" in rf:
+            failures.append("router_failover produced no verdict")
+        else:
+            proc3, doc3 = _cli("--scenario", "router_failover",
+                               "--seed", "0")
+            rf2 = (doc3.get("scenarios") or [{}])[0]
+            detail["router_replay"] = {
+                "digest": rf2.get("digest", "")[:16],
+                "matches": rf2.get("digest") == rf.get("digest"),
+            }
+            if rf2.get("digest") != rf.get("digest"):
+                failures.append(
+                    "router_failover replay diverged: "
+                    f"{rf.get('digest', '')[:16]} != "
+                    f"{rf2.get('digest', '')[:16]}")
     except subprocess.TimeoutExpired:
         failures.append(f"HANG (killed at {timeout}s)")
     except (ValueError, KeyError) as e:
@@ -3229,6 +3653,14 @@ def main():
                     help="run just the serving gate (sustained QPS, "
                          "hot reload, SIGTERM drain, serve.* faults, "
                          "retrace bound) and print its record")
+    ap.add_argument("--router-only", action="store_true",
+                    help="run just the serving-fabric router gate "
+                         "(backend SIGKILL mid-load -> evicted in the "
+                         "stale window + re-admitted, typed-503-only "
+                         "failures, stitched router->host->replica "
+                         "traces, blue/green cutover under load, "
+                         "autoscaler actuation/hysteresis) and print "
+                         "its record")
     ap.add_argument("--chaos-only", action="store_true",
                     help="run just the self-healing chaos gate (K "
                          "seeded randomized-fault 2-process runs + "
@@ -3273,9 +3705,10 @@ def main():
                          "scenario script green — 1000-host PS churn "
                          "with kills/rejoins and a healed partition "
                          "under 60s wall, preemption storm, elastic "
-                         "relaunch waves, GC races — plus a seeded "
-                         "ps_churn replay that must be bit-identical) "
-                         "and print its record")
+                         "relaunch waves, GC races, router failover "
+                         "under a load spike — plus seeded ps_churn + "
+                         "router_failover replays that must be "
+                         "bit-identical) and print its record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
                          "(2-process slow-step injection -> "
@@ -3329,6 +3762,11 @@ def main():
         print(json.dumps(serve_gate, indent=1))
         return 0 if serve_gate["passed"] else 1
 
+    if args.router_only:
+        route_gate = run_router_gate()
+        print(json.dumps(route_gate, indent=1))
+        return 0 if route_gate["passed"] else 1
+
     if args.obs_only:
         obs_gate = run_obs_gate()
         print(json.dumps(obs_gate, indent=1))
@@ -3343,6 +3781,7 @@ def main():
     res["gates"].append(coord_gate)
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
+    res["gates"].append(run_router_gate())
     res["gates"].append(run_chaos_gate())
     res["gates"].append(run_diff_ckpt_gate())
     res["gates"].append(run_elastic_gate())

@@ -42,11 +42,12 @@ def test_zero_budget_still_yields_complete_record():
     rec = _last_record(proc.stdout)
     # the loop COMPLETED (every config marked skipped, none lost)
     assert rec["partial"] is False
-    # 9 device configs + CPU serving + CPU ckpt-manifest overhead
-    # + CPU ckpt-async-save + CPU diff-ckpt + CPU retrace-proxy
-    # attribution + CPU reshard-restore + CPU comm-overlap proxy
+    # 9 device configs + CPU serving + CPU router overhead/failover
+    # + CPU ckpt-manifest overhead + CPU ckpt-async-save
+    # + CPU diff-ckpt + CPU retrace-proxy attribution
+    # + CPU reshard-restore + CPU comm-overlap proxy
     # + CPU ps-compress + CPU sim-swarm
-    assert len(rec["configs"]) == 18
+    assert len(rec["configs"]) == 19
     assert all(c.get("skipped") == "budget" for c in rec["configs"])
     # driver-contract top-level keys exist even with no headline run
     for key in ("metric", "value", "unit", "vs_baseline"):

@@ -1,0 +1,601 @@
+"""Serving-fabric router tier: BackendPool policy (eviction /
+re-admission / least-loaded picks), RouterServer forward-path edge
+cases (all-backends-dead typed 503, mid-request backend death retried
+on a sibling exactly once, malformed /metricsz degrading to
+round-robin), blue/green cutover semantics, engine.resize, and the
+replica autoscaler's actuation + hysteresis."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.observability import timeseries
+from dist_keras_tpu.resilience import faults
+from dist_keras_tpu.serving import (
+    BackendPool,
+    BlueGreenEngine,
+    Overloaded,
+    ReplicaAutoscaler,
+    RouterServer,
+    ServingEngine,
+    ServingServer,
+    default_route_port,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _model():
+    return mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, 4)) \
+        .astype(np.float32)
+
+
+def _engine(**kw):
+    kw.setdefault("replicas", 1)
+    kw.setdefault("batch_ladder", (1, 8))
+    kw.setdefault("max_latency_s", 0.001)
+    kw.setdefault("max_queue", 1024)
+    eng = ServingEngine(_model(), **kw)
+    for r in (1, 8):
+        eng.predict(_rows(r), timeout_s=120)  # warm the jit ladder
+    return eng
+
+
+def _free_port():
+    """A port that is (momentarily) free — nothing listens on it."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- BackendPool policy ------------------------------------------------
+def test_pool_needs_backends():
+    with pytest.raises(ValueError):
+        BackendPool([])
+
+
+def test_pool_pick_least_loaded_when_all_depths_known():
+    pool = BackendPool(["a:1", "b:1", "c:1"])
+    pool.note_probe("a:1", True, depth=5)
+    pool.note_probe("b:1", True, depth=1)
+    pool.note_probe("c:1", True, depth=9)
+    assert all(pool.pick() == "b:1" for _ in range(4))
+
+
+def test_pool_blind_candidate_degrades_pick_to_round_robin():
+    # one backend with UNKNOWN depth (malformed /metricsz) must not be
+    # starved by the others' known-shallow queues: the whole pick
+    # degrades to round-robin
+    pool = BackendPool(["a:1", "b:1"])
+    pool.note_probe("a:1", True, depth=0)
+    pool.note_probe("b:1", True, depth=None)
+    picked = {pool.pick() for _ in range(6)}
+    assert picked == {"a:1", "b:1"}
+
+
+def test_pool_evicts_on_consecutive_failures_and_readmits():
+    pool = BackendPool(["a:1", "b:1"], fail_threshold=3,
+                       stale_s=60.0, readmit_checks=2)
+    for _ in range(2):
+        pool.note_probe("a:1", False)
+    assert pool.live_count() == 2  # below threshold: still in
+    pool.note_probe("a:1", False)
+    assert pool.live_count() == 1
+    snap = {b["addr"]: b for b in pool.snapshot()}
+    assert snap["a:1"]["evicted_reason"] == "consecutive_failures"
+    assert pool.pick() == "b:1"
+    # re-admission needs readmit_checks CONSECUTIVE healthy probes
+    pool.note_probe("a:1", True, depth=0)
+    pool.sweep()
+    assert pool.live_count() == 1  # one lucky probe never re-admits
+    pool.note_probe("a:1", True, depth=0)
+    pool.sweep()
+    assert pool.live_count() == 2
+    assert pool.evictions == 1 and pool.readmissions == 1
+
+
+def test_pool_failure_resets_heal_streak():
+    pool = BackendPool(["a:1", "b:1"], fail_threshold=1,
+                       stale_s=60.0, readmit_checks=2)
+    pool.note_probe("a:1", False)  # evicted
+    pool.note_probe("a:1", True, depth=0)
+    pool.note_probe("a:1", False)  # flap: streak back to zero
+    pool.note_probe("a:1", True, depth=0)
+    pool.sweep()
+    assert pool.live_count() == 1  # still out: no 2-streak yet
+
+
+def test_pool_stale_health_eviction():
+    pool = BackendPool(["a:1"], fail_threshold=99, stale_s=0.05)
+    time.sleep(0.12)  # birth grace expires with no healthy probe
+    pool.sweep()
+    snap = pool.snapshot()[0]
+    assert not snap["live"]
+    assert snap["evicted_reason"] == "stale_health"
+
+
+def test_pool_heartbeat_evidence_evicts_and_blocks_readmit(tmp_path):
+    # the pod's own hb files are the third conviction — and a
+    # heartbeat-dead rank cannot re-enter on probe evidence alone
+    coord = str(tmp_path)
+    hb = os.path.join(coord, "hb")
+    os.makedirs(hb)
+    now = time.time()
+    for r, age in ((0, 0.0), (1, 60.0)):  # rank 1 beat once, went dark
+        p = os.path.join(hb, f"rank_{r}")
+        with open(p, "w"):
+            pass
+        os.utime(p, (now - age, now - age))
+    pool = BackendPool(["a:1", "b:1"], fail_threshold=99, stale_s=5.0,
+                       readmit_checks=1, coord_dir=coord, world_size=2)
+    pool.note_probe("a:1", True, depth=0)
+    pool.note_probe("b:1", True, depth=0)  # reachable, but hb-dead
+    pool.sweep()
+    snap = {b["addr"]: b for b in pool.snapshot()}
+    assert snap["a:1"]["live"]
+    assert not snap["b:1"]["live"]
+    assert snap["b:1"]["evicted_reason"] == "heartbeat_dead"
+    # healthy probes alone must NOT re-admit while the hb stays dark
+    pool.note_probe("b:1", True, depth=0)
+    pool.sweep()
+    assert not {b["addr"]: b for b in pool.snapshot()}["b:1"]["live"]
+    # the heartbeat resuming is what re-opens the door
+    os.utime(os.path.join(hb, "rank_1"), (now, now))
+    pool.note_probe("b:1", True, depth=0)
+    pool.sweep()
+    assert {b["addr"]: b for b in pool.snapshot()}["b:1"]["live"]
+
+
+def test_pool_pick_exclude_and_exhaustion():
+    pool = BackendPool(["a:1", "b:1"])
+    first = pool.pick(exclude=("a:1",))
+    assert first == "b:1"
+    assert pool.pick(exclude=("a:1", "b:1")) is None
+
+
+def test_default_route_port_reads_knob(monkeypatch):
+    monkeypatch.delenv("DK_ROUTE_PORT", raising=False)
+    assert default_route_port(fallback=1234) == 1234
+    monkeypatch.setenv("DK_ROUTE_PORT", "8123")
+    assert default_route_port() == 8123
+    monkeypatch.setenv("DK_ROUTE_PORT", "nonsense")
+    assert default_route_port(fallback=7) == 7
+
+
+# -- router HTTP edge cases --------------------------------------------
+def test_router_all_backends_dead_is_typed_503_never_a_hang():
+    # two addresses nothing listens on: the forward path must answer a
+    # typed 503 + Retry-After in bounded time — never hang, never leak
+    # an untyped exception to the client
+    backends = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    srv = RouterServer(backends, port=0, probe_s=30.0,
+                       forward_timeout_s=2.0, fail_threshold=2,
+                       stale_s=60.0, readmit_checks=2)
+    host, port = srv.start()
+    try:
+        body = json.dumps({"rows": _rows(1).tolist()}).encode()
+        t0 = time.monotonic()
+        seen = []
+        for _ in range(3):
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            e = ei.value
+            assert e.code == 503
+            assert e.headers.get("Retry-After") is not None
+            doc = json.loads(e.read().decode())
+            seen.append(doc["error"])
+        assert time.monotonic() - t0 < 20.0
+        # connect failures burn the fail threshold: first requests get
+        # the exhausted-retry form, later ones the empty-pool form
+        assert set(seen) <= {"backends_unavailable", "no_backends"}
+        assert seen[-1] == "no_backends"
+        assert srv.pool.live_count() == 0
+    finally:
+        srv.close()
+
+
+class _AbruptCloser:
+    """A listener that accepts a connection and slams it shut — the
+    router-visible signature of a backend SIGKILLed mid-request
+    (connection reset / empty response on an established socket)."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = "127.0.0.1:%d" % self.sock.getsockname()[1]
+        self.hits = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            conn.close()  # mid-request death
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_router_midrequest_death_retried_on_sibling_exactly_once():
+    # /predict is stateless and pure, so re-sending the SAME body to a
+    # sibling is idempotent by construction — the router exploits that
+    # for EXACTLY ONE re-send (attempts=2), with the dead backend
+    # excluded from the retry's pick
+    dead = _AbruptCloser()
+    eng = _engine()
+    alive = ServingServer(eng, port=0)
+    alive.start()
+    alive_addr = "%s:%d" % alive.address
+    srv = RouterServer([dead.addr, alive_addr], port=0, probe_s=30.0,
+                       forward_timeout_s=10.0, fail_threshold=5,
+                       stale_s=60.0, readmit_checks=2)
+    picks = []
+    real_pick = srv.pool.pick
+
+    def pick_dead_first(exclude=()):
+        picks.append(set(exclude))
+        if not exclude:
+            return dead.addr  # force the first attempt onto the victim
+        return real_pick(exclude=exclude)
+
+    srv.pool.pick = pick_dead_first
+    try:
+        body = json.dumps({"rows": _rows(1).tolist()}).encode()
+        code, payload, ctype, _retry = srv.forward(body)
+        assert code == 200
+        doc = json.loads(payload.decode())
+        assert len(doc["predictions"]) == 1
+        # exactly two attempts: the death, then ONE sibling re-send
+        assert picks == [set(), {dead.addr}]
+        assert dead.hits == 1
+        assert eng.stats()["completed"] >= 1
+    finally:
+        srv.close()
+        alive.close()
+        dead.close()
+
+
+def test_router_forward_exhaustion_is_typed_503():
+    # both attempts die mid-request -> typed 503, the caller's
+    # whole-request retry is the bounded one (no third in-process send)
+    d1, d2 = _AbruptCloser(), _AbruptCloser()
+    srv = RouterServer([d1.addr, d2.addr], port=0, probe_s=30.0,
+                       forward_timeout_s=5.0, fail_threshold=9,
+                       stale_s=60.0, readmit_checks=2)
+    try:
+        code, payload, _, retry_after = srv.forward(b"{}")
+        assert code == 503 and retry_after is not None
+        assert json.loads(payload.decode())["error"] \
+            == "backends_unavailable"
+        assert d1.hits + d2.hits == 2  # one attempt each, never more
+    finally:
+        srv.close()
+        d1.close()
+        d2.close()
+
+
+class _WeirdMetricsBackend:
+    """Healthy /healthz, garbage /metricsz — a degraded host whose
+    telemetry rotted before its serving path did."""
+
+    def __init__(self, metrics_body=b"%% not json %%"):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = (b'{"status": "serving"}'
+                        if self.path.startswith("/healthz")
+                        else outer.metrics_body)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.metrics_body = metrics_body
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.srv.daemon_threads = True
+        self.addr = "127.0.0.1:%d" % self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_router_malformed_metricsz_degrades_to_round_robin():
+    # a healthy-but-blind backend stays IN rotation with depth None —
+    # the probe never convicts on garbage, and the pool's pick
+    # degrades to round-robin instead of starving or favoring it
+    weird = _WeirdMetricsBackend()
+    eng = _engine()
+    alive = ServingServer(eng, port=0)
+    alive.start()
+    alive_addr = "%s:%d" % alive.address
+    srv = RouterServer([weird.addr, alive_addr], port=0, probe_s=30.0,
+                       fail_threshold=3, stale_s=60.0)
+    try:
+        healthy, depth = srv._probe_backend(weird.addr)
+        assert healthy is True and depth is None
+        healthy, depth = srv._probe_backend(alive_addr)
+        assert healthy is True and isinstance(depth, int)
+        srv.probe_once()
+        snap = {b["addr"]: b for b in srv.pool.snapshot()}
+        assert snap[weird.addr]["live"]  # blind, NOT evicted
+        assert snap[weird.addr]["depth"] is None
+        # round-robin: both backends keep getting picked
+        picked = {srv.pool.pick() for _ in range(6)}
+        assert picked == {weird.addr, alive_addr}
+    finally:
+        srv.close()
+        alive.close()
+        weird.close()
+
+
+def test_router_non_numeric_depth_is_blind_not_evicted():
+    weird = _WeirdMetricsBackend(
+        metrics_body=json.dumps(
+            {"engine": {"outstanding": True}}).encode())
+    srv = RouterServer([weird.addr], port=0, probe_s=30.0)
+    try:
+        healthy, depth = srv._probe_backend(weird.addr)
+        assert healthy is True and depth is None  # bool is NOT a depth
+    finally:
+        srv.close()
+        weird.close()
+
+
+def test_router_draining_rejects_typed_and_healthz_flips():
+    eng = _engine()
+    backend = ServingServer(eng, port=0)
+    backend.start()
+    srv = RouterServer(["%s:%d" % backend.address], port=0,
+                       probe_s=30.0)
+    host, port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read().decode())["status"] \
+                == "routing"
+        srv.drain()
+        body = json.dumps({"rows": _rows(1).tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body, method="POST")
+        with pytest.raises((urllib.error.HTTPError, OSError)) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        if isinstance(ei.value, urllib.error.HTTPError):
+            # a still-open keep-alive path answers the typed 503
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+    finally:
+        srv.close()
+        backend.close()
+
+
+# -- blue/green cutover ------------------------------------------------
+def test_bluegreen_cutover_inflight_drains_on_old_params():
+    models = []
+
+    def make_engine():
+        m = _model()
+        models.append(m)
+        return ServingEngine(m, replicas=1, batch_ladder=(1, 8),
+                             max_latency_s=0.001, max_queue=4096)
+
+    bg = BlueGreenEngine(make_engine)
+    try:
+        for r in (1, 8):
+            bg.predict(_rows(r), timeout_s=120)
+        rows = _rows(16, seed=3)
+        old_want = np.asarray(models[0].apply(models[0].params, rows))
+        # admit a burst to the OLD color, then cut over while it is
+        # (potentially) still in flight
+        futs = [bg.submit(r) for r in rows]
+        state = {"params": jax.tree.map(
+            lambda a: np.asarray(a) * 0.5, models[0].params)}
+        bg.set_params(state, step=1)
+        got = np.stack([f.result(timeout=60) for f in futs])
+        # every admitted request was served on the params it was
+        # admitted under — the old color's params never changed
+        assert np.allclose(got, old_want, atol=1e-5)
+        # traffic after the flip sees the NEW params
+        new_pred = bg.predict(rows[:4], timeout_s=60)
+        assert not np.allclose(new_pred, old_want[:4])
+        assert bg.cutovers == 1
+        assert bg.stats()["active_engine"] == 1
+    finally:
+        bg.close()
+
+
+def test_bluegreen_second_cutover_flips_back_and_resize_fans():
+    models = []
+
+    def make_engine():
+        m = _model()
+        models.append(m)
+        return ServingEngine(m, replicas=1, batch_ladder=(1, 8),
+                             max_latency_s=0.001, max_queue=256)
+
+    bg = BlueGreenEngine(make_engine)
+    try:
+        bg.predict(_rows(1), timeout_s=120)
+        state = {"params": models[0].params}
+        bg.set_params(state, step=1)
+        bg.set_params(state, step=2)
+        assert bg.cutovers == 2
+        assert bg.stats()["active_engine"] == 0  # A -> B -> A again
+        bg.resize(2)  # fans to BOTH colors: the standby must be at
+        assert bg.active.stats()["replicas"] == 2  # size when it
+        assert bg.standby.stats()["replicas"] == 2  # becomes active
+        st = bg.stats()
+        assert st["replicas"] == 2 and "standby_outstanding" in st
+    finally:
+        bg.close()
+
+
+# -- engine.resize -----------------------------------------------------
+def test_engine_resize_grow_and_shrink_keeps_serving():
+    eng = _engine(replicas=1)
+    try:
+        assert eng.stats()["replicas"] == 1
+        eng.resize(3)
+        assert eng.stats()["replicas"] == 3
+        preds = eng.predict(_rows(20), timeout_s=120)
+        assert preds.shape == (20, 3)
+        eng.resize(1)
+        assert eng.stats()["replicas"] == 1
+        preds = eng.predict(_rows(9, seed=2), timeout_s=120)
+        assert preds.shape == (9, 3)
+        with pytest.raises(ValueError):
+            eng.resize(0)
+    finally:
+        eng.close()
+
+
+def test_engine_resize_under_load_loses_nothing():
+    eng = _engine(replicas=2, max_queue=4096)
+    try:
+        rows = _rows(64, seed=5)
+        futs = [eng.submit(rows[i % 64]) for i in range(200)]
+        eng.resize(4)
+        futs += [eng.submit(rows[i % 64]) for i in range(200)]
+        eng.resize(1)
+        futs += [eng.submit(rows[i % 64]) for i in range(100)]
+        done = [f.result(timeout=120) for f in futs]
+        assert len(done) == 500
+        st = eng.stats()
+        assert st["completed"] >= 500 and st["replicas"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_resize_rejected_after_drain():
+    eng = _engine(replicas=1)
+    eng.drain(timeout_s=60)
+    with pytest.raises(Overloaded):
+        eng.resize(2)
+    eng.close()
+
+
+# -- autoscaler --------------------------------------------------------
+@pytest.fixture()
+def _fresh_rings():
+    timeseries.reset()
+    yield
+    timeseries.reset()
+
+
+def test_autoscaler_validates_bounds():
+    eng = _engine()
+    try:
+        with pytest.raises(ValueError):
+            ReplicaAutoscaler(eng, floor=0, ceiling=2)
+        with pytest.raises(ValueError):
+            ReplicaAutoscaler(eng, floor=3, ceiling=2)
+    finally:
+        eng.close()
+
+
+def test_autoscaler_holds_still_without_samples(_fresh_rings):
+    eng = _engine()
+    try:
+        a = ReplicaAutoscaler(eng, floor=1, ceiling=3, depth_high=8,
+                              samples=4)
+        assert a.tick() is None  # no ring at all: the safe hold
+        assert eng.stats()["replicas"] == 1
+    finally:
+        eng.close()
+
+
+def test_autoscaler_ramp_actuates_noise_holds_calm_descends(
+        _fresh_rings):
+    eng = _engine()
+    try:
+        a = ReplicaAutoscaler(eng, floor=1, ceiling=3, depth_high=8.0,
+                              samples=4, clear_checks=3,
+                              cooldown_checks=1, step=1)
+        ts = timeseries.series("serve.pending")
+        for v in (1.0, 3.0, 6.0):  # not enough evidence yet
+            ts.append(v)
+            assert a.tick() is None
+        ts.append(9.0)  # [1,3,6,9]: the QueueDepthGrowth signature
+        assert a.tick() == "up"
+        assert eng.stats()["replicas"] == 2
+        ts.append(10.0)
+        assert a.tick() is None  # cooldown holds even under a ramp
+        for v in (3.0, 7.0, 2.5, 6.0):  # noise: no ramp, not calm
+            ts.append(v)
+            assert a.tick() is None
+        assert eng.stats()["replicas"] == 2
+        downs = []
+        for v in (1.0, 0.0, 0.0, 1.0, 0.0, 0.0, 0.0):  # sustained calm
+            ts.append(v)
+            downs.append(a.tick())
+        assert downs.count("down") == 1
+        assert eng.stats()["replicas"] == 1  # floor: no further down
+        assert a.resizes == 2
+    finally:
+        eng.close()
+
+
+def test_autoscaler_ceiling_pins_and_p99_breach_scales(_fresh_rings):
+    from dist_keras_tpu.observability import metrics
+
+    eng = _engine()
+    try:
+        for _ in range(20):  # force a fat p99 into the shared registry
+            metrics.histogram("serve.predict_s").observe(5.0)
+        a = ReplicaAutoscaler(eng, floor=1, ceiling=2, depth_high=1e9,
+                              p99_high_s=0.5, samples=4,
+                              cooldown_checks=0)
+        assert a.tick() == "up"  # SLO breach alone actuates
+        assert eng.stats()["replicas"] == 2
+        assert a.tick() is None  # pinned at the ceiling: held, no churn
+        assert eng.stats()["replicas"] == 2
+    finally:
+        # the injected 5s observations must not leak into any other
+        # test reading the shared serve.predict_s histogram
+        metrics.histogram("serve.predict_s").reset()
+        eng.close()
