@@ -1408,6 +1408,106 @@ def bench_sim_swarm(peak=None, hosts=1000, timeout_s=300):
     }
 
 
+_SLO_OVERHEAD_WORKER = r"""
+import json, os, shutil, sys, tempfile, time
+import urllib.request
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 250
+
+
+def run_variant(slo_on, n):
+    # env BEFORE the resets: each observability module re-reads its
+    # knobs on first use after reset(), so one process measures both
+    # variants back to back (second variant also rides a warm jit)
+    work = tempfile.mkdtemp(prefix="dk_slo_bench_")
+    obs = os.path.join(work, "obs")
+    os.environ["DK_OBS_DIR"] = obs
+    os.environ["DK_OBS_SAMPLE_S"] = "0.25"
+    for k in ("DK_SLO", "DK_TRACE_RETAIN", "DK_SLO_LATENCY_S"):
+        os.environ.pop(k, None)
+    if slo_on:
+        os.environ["DK_SLO"] = "1"
+        os.environ["DK_TRACE_RETAIN"] = "1"
+        os.environ["DK_SLO_LATENCY_S"] = "0.05"
+    from dist_keras_tpu.observability import (events, flight, metrics,
+                                              slo, spans, timeseries)
+    for mod in (timeseries, events, metrics, flight, spans, slo):
+        mod.reset()
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.serving import ServingEngine, ServingServer
+    model = mnist_mlp(hidden=(32,), input_dim=16, num_classes=4)
+    eng = ServingEngine(model, replicas=1, batch_ladder=(1, 8),
+                        max_latency_s=0.001, max_queue=1024)
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(1, 16)).astype(np.float32)
+    eng.predict(rows, timeout_s=120)   # warm the ladder pre-listen
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    url = "http://%s:%d/predict" % (host, port)
+    body = json.dumps({"rows": rows.tolist()}).encode("utf-8")
+    lat = []
+    for _ in range(n):
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        lat.append(time.perf_counter() - t0)
+    srv.drain()
+    srv.close()
+    eng.close()
+    size = (sum(os.path.getsize(os.path.join(obs, fn))
+                for fn in os.listdir(obs))
+            if os.path.isdir(obs) else 0)
+    shutil.rmtree(work, ignore_errors=True)
+    lat.sort()
+    return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3),
+            "trace_bytes_per_1k": int(size / n * 1000)}
+
+
+off = run_variant(False, n)
+on = run_variant(True, n)
+print(json.dumps({
+    "n_requests": n,
+    "off": off,
+    "on": on,
+    "overhead_p50_pct": (round(100.0 * (on["p50_ms"] - off["p50_ms"])
+                               / off["p50_ms"], 1)
+                         if off["p50_ms"] else None),
+    "overhead_p99_pct": (round(100.0 * (on["p99_ms"] - off["p99_ms"])
+                               / off["p99_ms"], 1)
+                         if off["p99_ms"] else None),
+    "bytes_reduction_x": (round(off["trace_bytes_per_1k"]
+                                / on["trace_bytes_per_1k"], 1)
+                          if on["trace_bytes_per_1k"]
+                          else float(off["trace_bytes_per_1k"] > 0)),
+}), flush=True)
+"""
+
+
+def bench_slo_overhead(peak=None, n=250, timeout_s=300):
+    """Request-level SLO plane overhead (``slo_overhead``): served
+    HTTP p50/p99 with the full round-22 plane (trace exemplars +
+    tail-based retention + per-tick burn evaluation) ON vs OFF on the
+    same warm process, plus trace bytes per 1k healthy requests per
+    variant — the sublinear-retention evidence: with the plane ON,
+    healthy fast traces are dropped at request end, so the byte rate
+    FALLS even though every breaching request would keep a full trace.
+    CPU-pinned subprocess; no ``vs_baseline`` (the reference has no
+    SLO plane)."""
+    return _run_cpu_worker(
+        "slo_overhead", source=_SLO_OVERHEAD_WORKER, args=(n,),
+        strip_prefixes=("DK_SLO", "DK_TRACE"), timeout_s=timeout_s)
+
+
 def _backend_responsive(timeout_s=180):
     """Probe the default backend in a SUBPROCESS with a hard timeout.
 
@@ -1571,7 +1671,9 @@ def main():
                                   (bench_ps_compress,
                                    "ps_compress"),
                                   (bench_sim_swarm,
-                                   "sim_swarm")):
+                                   "sim_swarm"),
+                                  (bench_slo_overhead,
+                                   "slo_overhead")):
             t0 = time.time()
             _obs_emit("bench_config_begin", name=fn.__name__)
             try:
@@ -1604,7 +1706,7 @@ def main():
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,
                bench_comm_overlap, bench_ps_compress,
-               bench_sim_swarm,
+               bench_sim_swarm, bench_slo_overhead,
                bench_transformer_tp, bench_long_context):
         elapsed = time.time() - t_start
         if elapsed > budget:

@@ -90,6 +90,13 @@ RULES = {
     "metric-doc-drift":
         "the README metrics table names a metric that is not in "
         "metrics.KNOWN_METRICS",
+    "slo-undocumented":
+        "a slo.KNOWN_SLOS objective is missing from the README SLO "
+        "table (the objective vocabulary is registry-closed like "
+        "events and metrics)",
+    "slo-doc-drift":
+        "the README SLO table names an objective that is not in "
+        "slo.KNOWN_SLOS",
     "span-unregistered":
         "a span(...)/span_at(...) call site names a span missing from "
         "spans.KNOWN_SPANS (the report, the Perfetto export and "

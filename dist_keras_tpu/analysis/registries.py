@@ -27,6 +27,9 @@ like the real one):
   fnmatch; dynamic names annotate ``# dklint: spans=<pattern>``) —
   the span vocabulary the report, the Perfetto export and operator
   tooling attribute against is registry-closed like the others.
+- ``slo.KNOWN_SLOS``  <->  the README SLO objective table (marked
+  ``<!-- dklint: slos-table -->``) both ways — an objective nobody
+  documented cannot page anyone usefully.
 """
 
 from __future__ import annotations
@@ -107,7 +110,7 @@ def _extract_dict_assign(sf, target_name):
 
 def _extract_registries(project):
     regs = {"faults": None, "events": None, "metrics": None,
-            "knobs": None, "spans": None}
+            "knobs": None, "spans": None, "slos": None}
     for sf in project.files:
         if regs["faults"] is None:
             found = _extract_tuple_assign(sf, "KNOWN_POINTS")
@@ -125,6 +128,10 @@ def _extract_registries(project):
             found = _extract_dict_assign(sf, "KNOWN_METRICS")
             if found:
                 regs["metrics"] = (found[0], sf, found[1])
+        if regs["slos"] is None:
+            found = _extract_dict_assign(sf, "KNOWN_SLOS")
+            if found:
+                regs["slos"] = (found[0], sf, found[1])
         if sf.rel.endswith("knobs.py"):
             knob_names = []
             for node in ast.walk(sf.tree):
@@ -505,11 +512,13 @@ def run(project):
             else:
                 seen[pn] = name
 
-    findings += _check_readme(project, knob_reg, event_reg, metric_reg)
+    findings += _check_readme(project, knob_reg, event_reg, metric_reg,
+                              regs["slos"])
     return findings
 
 
-def _check_readme(project, knob_reg, event_reg, metric_reg):
+def _check_readme(project, knob_reg, event_reg, metric_reg,
+                  slo_reg=None):
     findings = []
     readme = project.readme
     if readme is None:
@@ -669,4 +678,30 @@ def _check_readme(project, knob_reg, event_reg, metric_reg):
                         f"README metrics table names {tok!r} which is "
                         "not in metrics.KNOWN_METRICS",
                         key=f"metric-doc-drift:{tok}"))
+
+    # SLO objectives <-> the marked SLO table (both ways, like events)
+    if slo_reg is not None:
+        names, sf_slos, reg_line = slo_reg
+        tokens = _marked_table_tokens(readme, "slos-table")
+        if tokens is None:
+            findings.append(Finding(
+                "slo-undocumented", rel, 1,
+                "README has no `<!-- dklint: slos-table -->` marker "
+                "before the SLO objective table",
+                key="slos-table-marker"))
+        else:
+            for name in names:
+                if name not in tokens:
+                    findings.append(Finding(
+                        "slo-undocumented", sf_slos.rel, reg_line,
+                        f"objective {name!r} has no row in the README "
+                        "SLO table", key=f"slo-doc:{name}"))
+            for tok, lineno in sorted(tokens.items()):
+                if re.fullmatch(r"[a-z0-9_]+", tok) \
+                        and tok not in names:
+                    findings.append(Finding(
+                        "slo-doc-drift", rel, lineno,
+                        f"README SLO table names {tok!r} which is not "
+                        "in slo.KNOWN_SLOS",
+                        key=f"slo-doc-drift:{tok}"))
     return findings

@@ -114,6 +114,7 @@ class Job:
                  coord_dir=None, coord_timeout_s=None, obs_dir=None,
                  serve_port=None, route_port=None, supervise=None,
                  metrics_port=None, obs_sample_s=None, trace_id=None,
+                 slo=False, trace_sample=None, trace_retain=False,
                  ps_addr=None, ps_window=None, runner=None):
         self.secret = secret
         # job_name becomes a remote path component and Punchcard feeds it
@@ -214,6 +215,16 @@ class Job:
                              else int(metrics_port))
         self.obs_sample_s = (None if obs_sample_s is None
                              else float(obs_sample_s))
+        # slo / trace_sample / trace_retain: the round-22 SLO plane.
+        # slo=True exports DK_SLO=1 on every host (default objectives,
+        # burn-rate watchdog rule, exemplar capture); trace_retain=True
+        # exports DK_TRACE_RETAIN=1 (tail-based span retention);
+        # trace_sample exports DK_TRACE_SAMPLE (healthy-trace
+        # head-sampling rate for the retention policy).
+        self.slo = bool(slo)
+        self.trace_sample = (None if trace_sample is None
+                             else float(trace_sample))
+        self.trace_retain = bool(trace_retain)
         # ps_addr: the parameter-server training plane.  When set,
         # every host's env gets DK_PS_ADDR (host:port of the
         # center-variable server) so an entrypoint running
@@ -398,6 +409,15 @@ class Job:
         if self.obs_sample_s is not None:
             # live-telemetry cadence: MetricsSampler + watchdog per host
             env["DK_OBS_SAMPLE_S"] = str(self.obs_sample_s)
+        if self.slo:
+            # SLO plane: default objectives + burn-rate rule +
+            # exemplar capture on every host
+            env["DK_SLO"] = "1"
+        if self.trace_retain:
+            # tail-based trace retention per host
+            env["DK_TRACE_RETAIN"] = "1"
+        if self.trace_sample is not None:
+            env["DK_TRACE_SAMPLE"] = str(self.trace_sample)
         if session is not None:
             env["DK_COORD_SESSION"] = str(session)
         return env

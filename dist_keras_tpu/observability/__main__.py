@@ -11,6 +11,11 @@
   # data/step/comm/ckpt host-wall breakdown, watchdog alerts
   python -m dist_keras_tpu.observability /path/to/obs_dir --perf
 
+  # SLOs: objective status + burn rates per window at alert time, and
+  # the worst-N retained requests with critical-path attribution
+  python -m dist_keras_tpu.observability /path/to/obs_dir --slo \
+      [--worst 5]
+
   # tracing: stitch the multi-host timeline into Perfetto-loadable
   # Chrome trace JSON (open at ui.perfetto.dev), or summarize trace
   # connectivity per trace_id
@@ -60,6 +65,16 @@ def main(argv=None):
                          "data/step/comm/ckpt host-wall breakdown, "
                          "and every watchdog alert in the timeline "
                          "(with --json: a 'perf' key on the summary)")
+    ap.add_argument("--slo", action="store_true",
+                    help="append the SLO section: per-objective "
+                         "burn-rate status from the slo_burn_rate "
+                         "alerts in the timeline plus the worst-N "
+                         "retained requests with critical-path "
+                         "attribution (with --json: a 'slo' key on "
+                         "the summary)")
+    ap.add_argument("--worst", type=int, default=5,
+                    help="requests in the --slo critical-path section "
+                         "(default 5)")
     ap.add_argument("--perfetto", metavar="PATH",
                     help="write the merged timeline as Chrome trace-"
                          "event JSON (Perfetto-loadable) to PATH")
@@ -98,6 +113,8 @@ def main(argv=None):
         doc = events if args.raw else report.summarize(events)
         if args.perf and not args.raw:
             doc["perf"] = report.perf_summary(events)
+        if args.slo and not args.raw:
+            doc["slo"] = report.slo_summary(events)
         json.dump(doc, sys.stdout, indent=1, default=str)
         print()
     else:
@@ -105,6 +122,10 @@ def main(argv=None):
         if args.perf:
             print()
             print(report.render_perf(args.obs_dir, events=events))
+        if args.slo:
+            print()
+            print(report.render_slo(args.obs_dir, events=events,
+                                    worst=args.worst))
     return 0 if events else 1
 
 

@@ -66,6 +66,7 @@ _writer = None         # EventWriter when enabled, None when disabled
 _warned = False        # one dropped-event warning per process
 _ctx_provider = None   # spans.py: current trace identity per thread
 _sink = None           # flight.py: in-memory ring copy of each record
+_retainer = None       # flight.py: tail-based trace-retention policy
 
 
 def _set_context_provider(fn):
@@ -75,6 +76,18 @@ def _set_context_provider(fn):
     into the span tree without their seams knowing about tracing."""
     global _ctx_provider
     _ctx_provider = fn
+
+
+def _set_retainer(fn):
+    """Register the tail-based retention policy
+    (``flight.TraceRetention.offer``): called with each fully-stamped
+    record and the writer BEFORE the file write; returning True means
+    the policy took custody (buffered for a keep/drop decision at
+    request end) and the record is not written now.  ``None`` (the
+    default, and whenever ``DK_TRACE_RETAIN`` is off) keeps the write
+    path untouched."""
+    global _retainer
+    _retainer = fn
 
 # The event vocabulary — every ``kind`` any seam emits (including the
 # repo-root ``bench.py`` driver's).  Adding an emit("...") call site?
@@ -121,6 +134,8 @@ KNOWN_EVENTS = (
     # telemetry plane (observability/)
     "perf_sample", "watchdog_alert", "watchdog_clear",
     "metrics_exporter_listen", "flight_dump",
+    # SLO plane (observability/slo.py)
+    "slo_transition",
     # bench driver (repo-root bench.py)
     "bench_probe_begin", "bench_probe_end", "bench_config_begin",
     "bench_config_end", "bench_config_skipped", "bench_complete",
@@ -210,16 +225,29 @@ class EventWriter:
         except OSError:  # pragma: no cover - double close
             pass
 
-    def emit(self, kind, **fields):
-        """Write one event line; -> the record dict (the flight
-        recorder's ring copy).  Raises on failure — the module-level
-        :func:`emit` is the never-throws wrapper."""
+    def make_record(self, kind, **fields):
+        """Stamp one record (``t``/``seq``/``rank``/``kind`` + fields)
+        WITHOUT writing it.  Split from :meth:`write` for tail-based
+        retention: a buffered record keeps its event-time stamps, so a
+        trace flushed seconds later still merges into the timeline at
+        the instant it happened (the report sorts by ``(t, rank,
+        seq)``, not file order)."""
         with self._lock:
             seq = self._seq
             self._seq += 1
         record = {"t": time.time(), "seq": seq, "rank": self.rank,
                   "kind": str(kind)}
         record.update(fields)
+        return record
+
+    def emit(self, kind, **fields):
+        """Write one event line; -> the record dict (the flight
+        recorder's ring copy).  Raises on failure — the module-level
+        :func:`emit` is the never-throws wrapper."""
+        return self.write(self.make_record(kind, **fields))
+
+    def write(self, record):
+        """Serialize + append one already-stamped record; -> it."""
         # default=str: an event must not be droppable by an exotic field
         # type (numpy scalar, Path, exception instance)
         line = (json.dumps(record, default=str) + "\n").encode("utf-8")
@@ -329,9 +357,13 @@ def emit(kind, **fields):
             if ctx:
                 for k, v in ctx.items():
                     fields.setdefault(k, v)
-        # dklint: ignore[event-dynamic] pure forwarder: the literal
-        # kind is checked at every emit() call site, not here
-        rec = w.emit(kind, **fields)
+        rec = w.make_record(kind, **fields)
+        ret = _retainer
+        if ret is not None and ret(rec, w):
+            # retention took custody: written (or dropped) when the
+            # request ends — the tail-based decision point
+            return
+        w.write(rec)
         sink = _sink
         if sink is not None:
             sink(rec)
@@ -344,7 +376,7 @@ def reset():
     """Close the writer and forget the cached ``DK_OBS_DIR`` decision —
     tests that flip the env need a fresh resolution.  The flight-
     recorder sink detaches too (re-attached at the next resolution)."""
-    global _resolved, _writer, _warned, _sink
+    global _resolved, _writer, _warned, _sink, _retainer
     with _lock:
         if _writer is not None:
             _writer.close()
@@ -352,3 +384,4 @@ def reset():
         _resolved = False
         _warned = False
         _sink = None
+        _retainer = None

@@ -108,8 +108,164 @@ class FlightRecorder:
         return path
 
 
+# --- tail-based trace retention (round 22) -------------------------
+#
+# Event kinds that belong to a per-request trace: these are the
+# records retention may buffer (everything else — lifecycle events,
+# perf samples, alerts — always writes through immediately).
+_RETAIN_KINDS = frozenset({
+    "span_begin", "span_end", "serve_enqueue", "serve_batch_flush",
+    "serve_batch_error", "serve_predict_error",
+})
+# Span paths whose ``span_end`` marks a request's END — the tail-based
+# decision point for that trace's local buffer.  Matched on the dotted
+# path suffix so a root nested under an outer span still decides.
+_ROOT_SPANS = ("serve.request", "serve.client", "route.forward")
+
+
+def _is_root_end(rec):
+    if rec.get("kind") != "span_end":
+        return False
+    path = str(rec.get("span", ""))
+    return any(path == r or path.endswith("." + r) for r in _ROOT_SPANS)
+
+
+class TraceRetention:
+    """Keep full span records only for requests worth keeping.
+
+    Buffers trace-stamped records per ``trace_id`` (custody taken from
+    the event writer via ``events._set_retainer``) and decides at
+    request END — the root span's ``span_end`` — whether to flush the
+    buffer to the log or drop it:
+
+    - **slow**: root duration >= ``slow_s`` (``DK_TRACE_RETAIN_SLOW_S``,
+      defaulting to the SLO latency bar ``DK_SLO_LATENCY_S``) — every
+      objective-breaching request keeps its complete trace;
+    - **errored**: any buffered record carries an ``error`` field or an
+      error kind;
+    - **head-sampled**: a pure hash of the trace id falls under
+      ``DK_TRACE_SAMPLE`` — a deterministic healthy-traffic baseline
+      (replays keep the same traces; no RNG).
+
+    Everything else is dropped (counted, not logged), so steady
+    healthy traffic stops growing the event log linearly.  The
+    in-flight buffer is bounded by ``DK_TRACE_RETAIN_BUDGET`` traces;
+    past the budget the OLDEST buffer is flushed — fail OPEN: memory
+    pressure can only make retention keep more, never lose an
+    incident's trace.  :func:`dump` flushes all in-flight buffers
+    first, so an alert/crash artifact always includes the traces that
+    were still in progress.
+    """
+
+    def __init__(self, slow_s=None, sample=None, budget=None):
+        if slow_s is None:
+            slow_s = knobs.get("DK_TRACE_RETAIN_SLOW_S")
+            if slow_s is None:
+                slow_s = knobs.get("DK_SLO_LATENCY_S")
+        self.slow_s = float(slow_s)
+        self.sample = float(knobs.get("DK_TRACE_SAMPLE")
+                            if sample is None else sample)
+        self.budget = max(1, int(knobs.get("DK_TRACE_RETAIN_BUDGET")
+                                 if budget is None else budget))
+        self._buf = collections.OrderedDict()  # trace_id -> [records]
+        self._writer = None
+        self._lock = threading.Lock()
+
+    def offer(self, rec, writer):
+        """The ``events`` seam: -> True when custody of ``rec`` is
+        taken (buffered or decided here), False to write through."""
+        if rec.get("kind") not in _RETAIN_KINDS:
+            return False
+        tid = rec.get("trace_id")
+        if not tid:
+            return False
+        self._writer = writer
+        evicted = decided = None
+        with self._lock:
+            buf = self._buf.get(tid)
+            if buf is None:
+                if len(self._buf) >= self.budget:
+                    _, evicted = self._buf.popitem(last=False)
+                buf = self._buf[tid] = []
+            buf.append(rec)
+            if _is_root_end(rec):
+                decided = self._buf.pop(tid)
+            inflight = len(self._buf)
+        if evicted is not None:
+            # budget overflow: fail open — flush, never drop unseen
+            metrics.counter("trace.retained").inc()
+            self._flush(evicted, writer)
+        if decided is not None:
+            if self._keep(decided, rec):
+                metrics.counter("trace.retained").inc()
+                self._flush(decided, writer)
+            else:
+                metrics.counter("trace.dropped").inc()
+                metrics.counter("trace.dropped_records").inc(
+                    len(decided))
+        metrics.gauge("trace.inflight").set(inflight)
+        return True
+
+    def _keep(self, records, root_rec):
+        try:
+            dur = float(root_rec.get("duration_s") or 0.0)
+        except (TypeError, ValueError):
+            dur = 0.0
+        if dur >= self.slow_s:
+            return True
+        for r in records:
+            if "error" in r or "error" in str(r.get("kind", "")):
+                return True
+        if self.sample > 0.0:
+            try:
+                h = int(str(root_rec.get("trace_id", ""))[:8], 16)
+            except ValueError:
+                h = 0
+            if h / 0xFFFFFFFF < self.sample:
+                return True
+        return False
+
+    def _flush(self, records, writer):
+        sink = events._sink
+        for r in records:
+            writer.write(r)
+            if sink is not None:
+                sink(r)
+
+    def flush_all(self):
+        """Flush every in-flight buffer (drain / incident dump /
+        process teardown): undecided traces are retained — fail open.
+        Never throws; -> the number of records flushed."""
+        with self._lock:
+            bufs = list(self._buf.values())
+            self._buf.clear()
+        w, n = self._writer, 0
+        for records in bufs:
+            if w is None:
+                break
+            try:
+                self._flush(records, w)
+                metrics.counter("trace.retained").inc()
+                n += len(records)
+            # dklint: ignore[broad-except] a failed flush must not add a failure to the drain/incident path
+            except Exception as e:
+                print(f"[dk.observability] WARNING: trace retention "
+                      f"flush failed: {e!r}", file=sys.stderr,
+                      flush=True)
+                break
+        metrics.gauge("trace.inflight").set(0)
+        return n
+
+    def stats(self):
+        with self._lock:
+            inflight = len(self._buf)
+        return {"inflight": inflight, "slow_s": self.slow_s,
+                "sample": self.sample, "budget": self.budget}
+
+
 _lock = threading.Lock()
 _recorder = None
+_retention = None
 _hooks_installed = False
 
 
@@ -128,9 +284,31 @@ def attach():
     when ``DK_OBS_DIR`` selects a writer; idempotent.  The sink is the
     module-level :func:`record` — it resolves ``recorder()`` per call,
     so a test's :func:`reset` swaps in a fresh ring without the sink
-    feeding a discarded one."""
+    feeding a discarded one.  When ``DK_TRACE_RETAIN`` is armed this
+    also installs the tail-based :class:`TraceRetention` policy into
+    the event seam."""
+    global _retention
     events._sink = record
+    if knobs.get("DK_TRACE_RETAIN"):
+        with _lock:
+            if _retention is None:
+                _retention = TraceRetention()
+        events._set_retainer(_retention.offer)
     _install_crash_hooks()
+
+
+def retention():
+    """The active :class:`TraceRetention` policy, or None when
+    ``DK_TRACE_RETAIN`` is off."""
+    return _retention
+
+
+def retain_flush():
+    """Flush every in-flight retention buffer to the event log (drain
+    paths, incident dumps); no-op when retention is off.  -> records
+    flushed."""
+    r = _retention
+    return r.flush_all() if r is not None else 0
 
 
 def record(rec):
@@ -146,6 +324,11 @@ def dump(reason, **fields):
     d = events.obs_dir()
     if d is None:
         return None
+    # flush in-flight retention buffers FIRST: the incident's own
+    # trace is usually still undecided at alert time, and a dump that
+    # lost it would defeat the whole "every incident keeps its trace"
+    # contract
+    retain_flush()
     try:
         path = recorder().dump(reason, d, events.rank() or 0, **fields)
     # dklint: ignore[broad-except] a failed dump must not add a failure to the incident it records
@@ -196,8 +379,11 @@ def tracez_doc():
     (JSON-ready — every record already round-tripped the writer's
     serializer)."""
     rec = recorder()
+    r = _retention
     return {"rank": events.rank(), "enabled": events.enabled(),
-            **rec.stats(), "records": rec.records()}
+            **rec.stats(),
+            "retention": r.stats() if r is not None else None,
+            "records": rec.records()}
 
 
 def load_dump(path):
@@ -268,6 +454,7 @@ def reset():
     and the installed flag stays set — re-chaining on every reset would
     stack hook frames; the hooks read the live recorder through
     :func:`dump`, so a fresh ring is all a test needs."""
-    global _recorder
+    global _recorder, _retention
     with _lock:
         _recorder = None
+        _retention = None

@@ -28,10 +28,37 @@ Design points:
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 from dist_keras_tpu.observability import events
+
+# Exemplar capture (round 22): when the SLO plane is armed
+# (``DK_SLO``), every histogram observation made under an open span
+# records that span's ``(trace_id, span_id)`` in a small per-histogram
+# ring, so a scrape's bad percentile links straight to a retained
+# trace.  ``spans.py`` registers the provider at import (it already
+# imports this module, so the hook avoids a metrics->spans cycle the
+# same way ``events._set_context_provider`` does); the knob is read
+# once and cached, keeping the disarmed observe path at two global
+# loads.
+_exemplar_provider = None   # () -> (trace_id, span_id) | None
+_exemplars_on = None        # cached DK_SLO (tri-state: None = unknown)
+
+
+def _set_exemplar_provider(fn):
+    global _exemplar_provider
+    _exemplar_provider = fn
+
+
+def _exemplars_enabled():
+    global _exemplars_on
+    if _exemplars_on is None:
+        from dist_keras_tpu.utils import knobs
+
+        _exemplars_on = bool(knobs.get("DK_SLO"))
+    return _exemplars_on
 
 
 class Counter:
@@ -81,32 +108,74 @@ class Histogram:
     """
 
     WINDOW = 4096
+    EXEMPLARS = 8
 
     def __init__(self, name=None):
         import collections
 
         self.name = name
         self._window = collections.deque(maxlen=self.WINDOW)
+        self._exemplars = collections.deque(maxlen=self.EXEMPLARS)
         self._count = 0
         self._total = 0.0
         self._max = None
+        self._over = {}  # threshold -> cumulative count(value > thr)
         self._lock = threading.Lock()
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record one sample.  ``exemplar``: optional ``(trace_id,
+        span_id)`` linking this observation to a trace; when omitted
+        and the SLO plane is armed, the current span's ids are
+        captured automatically (provider registered by ``spans.py``).
+        """
         value = float(value)
+        if exemplar is None and _exemplar_provider is not None \
+                and _exemplars_enabled():
+            exemplar = _exemplar_provider()
         with self._lock:
             self._window.append(value)
             self._count += 1
             self._total += value
             if self._max is None or value > self._max:
                 self._max = value
+            for thr in self._over:
+                if value > thr:
+                    self._over[thr] += 1
+            if exemplar is not None:
+                self._exemplars.append(
+                    (str(exemplar[0]), str(exemplar[1]), value,
+                     time.time()))
+
+    def track_over(self, threshold):
+        """Start counting observations ABOVE ``threshold`` exactly
+        (cumulative, like ``count``) — the latency-SLO seam: one float
+        compare per observe once registered, zero when not."""
+        thr = float(threshold)
+        with self._lock:
+            self._over.setdefault(thr, 0)
+
+    def over(self, threshold):
+        """Cumulative count of observations above a tracked threshold
+        (0 for a threshold never registered)."""
+        with self._lock:
+            return self._over.get(float(threshold), 0)
+
+    def exemplars(self):
+        """-> recent exemplars, newest last:
+        ``[{trace_id, span_id, value, t}, ...]``."""
+        with self._lock:
+            items = list(self._exemplars)
+        return [{"trace_id": tid, "span_id": sid, "value": v, "t": t}
+                for tid, sid, v, t in items]
 
     def reset(self):
         with self._lock:
             self._window.clear()
+            self._exemplars.clear()
             self._count = 0
             self._total = 0.0
             self._max = None
+            self._over = {thr: 0 for thr in self._over}
 
     @property
     def samples(self):
@@ -233,6 +302,14 @@ KNOWN_METRICS = {
     "watchdog.firing.*": "gauge",
     # flight recorder (observability/flight.py)
     "flight.dumps": "counter",
+    # SLO plane (observability/slo.py): per-objective burn gauges —
+    # slo.<objective>.burn_fast / .burn_slow / .firing
+    "slo.*": "gauge",
+    # tail-based trace retention (observability/flight.py)
+    "trace.retained": "counter",
+    "trace.dropped": "counter",
+    "trace.dropped_records": "counter",
+    "trace.inflight": "gauge",
     # cluster simulator (sim/)
     "sim.host_steps": "counter",
     "sim.faults": "counter",
@@ -283,8 +360,12 @@ def snapshot(percentiles=True):
         elif isinstance(inst, Gauge):
             out["gauges"][name] = inst.value
         else:
-            out["histograms"][name] = (inst.summary() if percentiles
-                                       else inst.totals())
+            h = inst.summary() if percentiles else inst.totals()
+            if percentiles:
+                ex = inst.exemplars()
+                if ex:
+                    h["exemplars"] = ex
+            out["histograms"][name] = h
     return out
 
 
@@ -311,5 +392,7 @@ def to_prometheus(**kw):
 
 def reset():
     """Drop every registered instrument (tests)."""
+    global _exemplars_on
     with _lock:
         _registry.clear()
+    _exemplars_on = None

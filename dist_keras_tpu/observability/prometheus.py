@@ -109,6 +109,16 @@ def render(snapshot=None, labels=None, extra_gauges=None, rank=None,
             lines.append(f"{mn}{qlbl} {_num(float(val))}")
         lines.append(f"{mn}_sum{lbl} {_num(float(h.get('total', 0.0)))}")
         lines.append(f"{mn}_count{lbl} {_num(int(h.get('count', 0)))}")
+        # OpenMetrics-style exemplar comments (round 22): a scrape's
+        # bad percentile links straight to a retained trace.  Comment
+        # syntax keeps the 0.0.4 text parsers happy — they skip '#'
+        # lines they don't know — while the trace/span ids stay
+        # machine-recoverable from the scrape body.
+        for ex in h.get("exemplars", ()):
+            xl = _fmt_labels({"trace_id": ex.get("trace_id", ""),
+                              "span_id": ex.get("span_id", "")})
+            lines.append(
+                f"# {xl} {_num(float(ex.get('value', 0.0)))}")
     for name in sorted(extra_gauges or {}):
         v = (extra_gauges or {})[name]
         if not isinstance(v, (int, float)) or isinstance(v, bool):

@@ -351,6 +351,111 @@ def _fmt_fields(ev, skip=("t", "seq", "rank", "kind")):
     return " ".join(parts)
 
 
+def slo_summary(events):
+    """SLO-plane digest from the merged timeline — for ``--slo``.
+
+    Objective status and burn rates come from the ``slo_transition``
+    and ``watchdog_alert`` (rule ``slo_burn_rate``) payloads, which
+    carry the registry's evaluation at alert time: ``perf_sample``
+    records don't ship the serving counters, so the offline report
+    reads the burns the live evaluator published rather than
+    recomputing them.
+    """
+    per_rank = {}
+
+    def _row(rank):
+        return per_rank.setdefault(
+            int(rank), {"firing": [], "objectives": {}})
+
+    n_transitions = 0
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "slo_transition":
+            n_transitions += 1
+            row = _row(ev.get("rank", 0))
+            row["firing"] = sorted(ev.get("firing", ()) or ())
+        elif (kind == "watchdog_alert"
+                and ev.get("rule") == "slo_burn_rate"):
+            row = _row(ev.get("rank", 0))
+            row["objectives"][str(ev.get("objective", "?"))] = {
+                "t": ev.get("t", 0.0),
+                "page": ev.get("page"),
+                "target": ev.get("target"),
+                "burn": {"5m": ev.get("burn_5m"),
+                         "1h": ev.get("burn_1h"),
+                         "6h": ev.get("burn_6h")},
+            }
+        elif (kind == "watchdog_clear"
+                and ev.get("rule") == "slo_burn_rate"):
+            for o in _row(ev.get("rank", 0))["objectives"].values():
+                o["cleared"] = True
+    return {"per_rank": per_rank, "transitions": n_transitions}
+
+
+def _fmt_burn(v):
+    return "?" if v is None else f"{v:g}"
+
+
+def render_slo(directory, events=None, worst=5):
+    """Human-readable SLO section for ``--slo``: per-rank objective
+    status with the burn rates at alert time, then the worst-``worst``
+    retained requests with their cross-host critical-path
+    attribution (queue wait vs forward hop vs replica compute vs
+    reload stall)."""
+    from dist_keras_tpu.observability import trace_export
+
+    if events is None:
+        events = read_events(directory)
+    s = slo_summary(events)
+    lines = ["# SLO report"]
+    t0 = events[0].get("t", 0.0) if events else 0.0
+    if not s["per_rank"] and not s["transitions"]:
+        lines.append("no SLO telemetry recorded (burn-rate evaluation "
+                     "rides the sampler tick — was the run armed with "
+                     "DK_SLO=1 and a DK_OBS_SAMPLE_S cadence?)")
+    for rank in sorted(s["per_rank"]):
+        row = s["per_rank"][rank]
+        firing = ", ".join(row["firing"]) if row["firing"] else "none"
+        lines.append(f"rank {rank}: firing objectives: {firing}")
+        for name in sorted(row["objectives"]):
+            o = row["objectives"][name]
+            b = o["burn"]
+            status = ("cleared" if o.get("cleared")
+                      else f"{o.get('page', '?')} page")
+            lines.append(
+                f"  {name}: target={o.get('target')} burn "
+                f"5m={_fmt_burn(b['5m'])} 1h={_fmt_burn(b['1h'])} "
+                f"6h={_fmt_burn(b['6h'])} "
+                f"[{status}, alerted +{o['t'] - t0:.3f}s]")
+    paths = trace_export.request_paths(events, worst=worst)
+    if paths:
+        lines.append(f"worst {len(paths)} retained request(s) by "
+                     "end-to-end latency:")
+        for p in paths:
+            crit = p["critical"]
+            lines.append(
+                f"  trace {p['trace_id']}: {p['total_s'] * 1e3:.1f}ms "
+                f"root {p['root']} (rank {p['rank']}) — critical hop "
+                f"{crit['span']} ({crit['category']}) on rank "
+                f"{crit['rank']}, self {crit['self_s'] * 1e3:.1f}ms")
+            for hop in p["path"]:
+                lines.append(
+                    f"    {hop['span']:<20} rank {hop['rank']} "
+                    f"{hop['category']:<16} "
+                    f"total={hop['duration_s'] * 1e3:8.1f}ms "
+                    f"self={hop['self_s'] * 1e3:8.1f}ms")
+            cats = ", ".join(
+                f"{k}={v * 1e3:.1f}ms" for k, v in
+                sorted(p["by_category"].items(),
+                       key=lambda kv: -kv[1]))
+            lines.append(f"    attribution: {cats}")
+    else:
+        lines.append("retained requests: none (tail-based retention "
+                     "keeps span records only for slow/errored/head-"
+                     "sampled requests — was DK_TRACE_RETAIN=1 armed?)")
+    return "\n".join(lines)
+
+
 def render(directory, last_n=10):
     """Human-readable report: summary + the last-N events per host."""
     events = read_events(directory)

@@ -400,4 +400,14 @@ def reset():
 # every event emitted while a span is open carries the trace identity —
 # the "chunk"/"coord"/"ckpt_save" breadcrumbs stitch into the same tree
 # as the spans without any extra emission
+def _exemplar_ids():
+    """metrics.py exemplar provider: the current span's ``(trace_id,
+    span_id)`` tuple, or None when no span is open.  Only consulted
+    when the SLO plane (``DK_SLO``) is armed — the disarmed observe
+    path never calls this."""
+    ids = _current_ids()
+    return (ids["trace_id"], ids["span_id"]) if ids else None
+
+
 events._set_context_provider(_current_ids)
+metrics._set_exemplar_provider(_exemplar_ids)

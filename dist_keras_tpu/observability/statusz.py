@@ -15,6 +15,8 @@ future router tier) reads ONE shape regardless of which port answered:
 - ``spans`` — the open-span path per live thread
   (``spans.open_spans()``): a wedged process shows WHERE it is wedged;
 - ``flight`` — recorder ring stats (capacity / retained / dumps);
+- ``slz`` — the SLO plane: armed-or-not, each objective's last burn
+  rates per window and firing flags (``slo.status_doc()``);
 - ``uptime_s`` since this module first rendered (process-start proxy).
 """
 
@@ -25,7 +27,7 @@ import os
 import sys
 import time
 
-from dist_keras_tpu.observability import events, flight, spans
+from dist_keras_tpu.observability import events, flight, slo, spans
 from dist_keras_tpu.utils import knobs
 
 _t0 = time.time()
@@ -57,6 +59,7 @@ def status_doc(extra=None):
         "knobs": knob_rows,
         "spans": spans.open_spans(),
         "flight": flight.recorder().stats(),
+        "slz": slo.status_doc(),
     }
     if extra:
         doc.update(extra)

@@ -228,6 +228,14 @@ class MetricsSampler:
         # dklint: ignore[broad-except] a registry snapshot failure must not kill the sampler tick
         except Exception:  # pragma: no cover - registry must not kill
             pass
+        # SLO evaluation runs AFTER the rings absorb this tick's
+        # snapshot (objectives read the rings) and BEFORE the watchdog
+        # check (SLOBurnRate reads the evaluation, idempotent per
+        # timestamp).  maybe_evaluate is a no-op unless DK_SLO is
+        # armed, and never throws.
+        from dist_keras_tpu.observability import slo
+
+        slo.maybe_evaluate(now)
         if self.watchdog is not None:
             try:
                 self.watchdog.check(now=now)

@@ -106,6 +106,32 @@ def chrome_trace(records, trace_id=None, instants=True):
         events.append({"ph": "f", "cat": "handoff", "name": "handoff",
                        "bp": "e", "id": sid, "pid": cpid, "tid": ctid,
                        "ts": max(cts, pts)})
+    # critical-path flow arrows (round 22): the dominant chain of each
+    # request renders as its own arrow family, so Perfetto shows WHERE
+    # a slow request's time went without hand-tracing the tree
+    traces = {}
+    for ev in spans:
+        tr = ev.get("trace_id")
+        if tr:
+            traces.setdefault(tr, []).append(ev)
+    for tr, tspans in sorted(traces.items()):
+        cp = critical_path(tspans)
+        if cp is None or len(cp["path"]) < 2:
+            continue
+        for parent_hop, child_hop in zip(cp["path"], cp["path"][1:]):
+            sid = child_hop.get("span_id")
+            if not sid or sid not in index \
+                    or parent_hop.get("span_id") not in index:
+                continue
+            ppid, ptid, pts = index[parent_hop["span_id"]]
+            cpid, ctid, cts = index[sid]
+            events.append({"ph": "s", "cat": "critical_path",
+                           "name": "critical_path", "id": f"cp-{sid}",
+                           "pid": ppid, "tid": ptid, "ts": pts})
+            events.append({"ph": "f", "cat": "critical_path",
+                           "name": "critical_path", "bp": "e",
+                           "id": f"cp-{sid}", "pid": cpid, "tid": ctid,
+                           "ts": max(cts, pts)})
     if instants:
         for ev in records:
             kind = ev.get("kind", "?")
@@ -128,6 +154,155 @@ def write_chrome_trace(path, records, trace_id=None, instants=True):
     with open(path, "w") as f:
         json.dump(doc, f, default=str)
     return len(doc["traceEvents"])
+
+
+# span name -> hop category for the per-request latency attribution
+# table (suffix-matched on the dotted path, so a root nested under an
+# outer span still classifies)
+_HOP_CATEGORY = {
+    "serve.client": "client_overhead",
+    "route.forward": "forward_hop",
+    "serve.request": "host_overhead",
+    "serve.queue_wait": "queue_wait",
+    "serve.batch": "batch",
+    "serve.exec": "replica_compute",
+    "serve.reload": "reload_stall",
+}
+
+
+def _category(span_name):
+    path = str(span_name)
+    for name, cat in _HOP_CATEGORY.items():
+        if path == name or path.endswith("." + name):
+            return cat
+    return "other"
+
+
+def _interval_s(ev):
+    ts, dur = _slice_ts_us(ev)
+    return ts / 1e6, (ts + dur) / 1e6
+
+
+def _union_len(intervals):
+    """Total length of a union of (a, b) intervals."""
+    total, end = 0.0, None
+    for a, b in sorted(intervals):
+        if b <= a:
+            continue
+        if end is None or a >= end:
+            total += b - a
+            end = b
+        elif b > end:
+            total += b - end
+            end = b
+    return total
+
+
+def critical_path(spans):
+    """Per-request latency attribution over ONE trace's ``span_end``
+    records (possibly spanning ranks — the router-stitched tree).
+
+    -> ``{"trace_id", "root", "rank", "total_s", "path": [hop, ...],
+    "by_category": {category: seconds}, "critical": hop}`` or None
+    when the trace has no usable root.
+
+    Two complementary views of the same tree:
+
+    - ``by_category``: exact decomposition of the root's elapsed time
+      by hop SELF time (duration minus the union of direct children's
+      overlap), so queue wait vs forward hop vs replica compute vs
+      reload stall sum to the total — nothing double-counted, nothing
+      lost;
+    - ``path``: the dominant chain root -> deepest hop, descending
+      into the longest child at each level (each hop:
+      ``{"span", "category", "rank", "tid", "duration_s", "self_s"}``)
+      — the "where did THIS request's time go" answer; ``critical``
+      is the single hop with the largest self time anywhere in the
+      tree (the one to fix).
+    """
+    by_id = {ev["span_id"]: ev for ev in spans if ev.get("span_id")}
+    children = {}
+    roots = []
+    for ev in spans:
+        parent = ev.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(ev)
+        else:
+            roots.append(ev)
+    if not roots:
+        return None
+    root = max(roots, key=lambda ev: float(ev.get("duration_s", 0.0)
+                                           or 0.0))
+
+    def _hop(ev):
+        a, b = _interval_s(ev)
+        kids = children.get(ev.get("span_id"), ())
+        overlap = _union_len(
+            [(max(a, ka), min(b, kb))
+             for ka, kb in (_interval_s(k) for k in kids)])
+        return {
+            "span": str(ev.get("span", "?")),
+            "category": _category(ev.get("span", "")),
+            "rank": int(ev.get("rank", 0)),
+            "tid": int(ev.get("tid", 0) or 0),
+            "span_id": ev.get("span_id"),
+            "duration_s": round(max(0.0, b - a), 6),
+            "self_s": round(max(0.0, (b - a) - overlap), 6),
+        }
+
+    # exact decomposition: every reachable node's self time, grouped
+    by_category = {}
+    hops = []
+    stack = [root]
+    seen = set()
+    while stack:
+        ev = stack.pop()
+        sid = ev.get("span_id")
+        if sid in seen:
+            continue
+        seen.add(sid)
+        hop = _hop(ev)
+        hops.append(hop)
+        by_category[hop["category"]] = round(
+            by_category.get(hop["category"], 0.0) + hop["self_s"], 6)
+        stack.extend(children.get(sid, ()))
+    # the dominant chain: descend into the longest child each level
+    path = []
+    ev = root
+    while ev is not None:
+        path.append(_hop(ev))
+        kids = children.get(ev.get("span_id"), ())
+        ev = max(kids, key=lambda k: float(k.get("duration_s", 0.0)
+                                           or 0.0)) if kids else None
+    critical = max(hops, key=lambda h: h["self_s"])
+    return {
+        "trace_id": root.get("trace_id"),
+        "root": str(root.get("span", "?")),
+        "rank": int(root.get("rank", 0)),
+        "total_s": round(float(root.get("duration_s", 0.0) or 0.0), 6),
+        "path": path,
+        "by_category": by_category,
+        "critical": critical,
+    }
+
+
+def request_paths(records, worst=None):
+    """:func:`critical_path` for every trace in a merged timeline,
+    sorted worst-first by root duration (``worst`` caps the list) —
+    the report's exemplar-linked worst-N table: each row's
+    ``trace_id`` is exactly what a scrape exemplar references."""
+    traces = {}
+    for ev in _span_ends(records):
+        tr = ev.get("trace_id")
+        if tr:
+            traces.setdefault(tr, []).append(ev)
+    out = []
+    for spans in traces.values():
+        cp = critical_path(spans)
+        if cp is not None:
+            out.append(cp)
+    out.sort(key=lambda cp: cp["total_s"], reverse=True)
+    return out[:worst] if worst else out
 
 
 def connected_traces(records):

@@ -293,14 +293,25 @@ def default_rules():
     process that gauge is never recorded and the gate is inert (the
     narrow cost: a co-resident idle serving engine holds the dispatch
     stall clock during training — a missed page there beats paging
-    every host on every quiet night)."""
-    return [
+    every host on every quiet night).  With ``DK_SLO`` armed the set
+    also carries ``slo.SLOBurnRate`` (lazy import: slo depends on this
+    module for the ``Rule`` base, so the reach-back stays inside the
+    function body)."""
+    rules = [
         StepTimeRegression(),
         ThroughputStall("perf.dispatches", pending_metric="serve.pending"),
         ThroughputStall("serve.completed", pending_metric="serve.pending"),
         QueueDepthGrowth("serve.pending"),
         HeartbeatQuiet(),
     ]
+    try:
+        from dist_keras_tpu.observability import slo
+
+        rules.extend(slo.burn_rules())
+    # dklint: ignore[broad-except] a broken SLO plane degrades to the classic rule set
+    except Exception:  # pragma: no cover - slo plane optional
+        pass
+    return rules
 
 
 class Watchdog:

@@ -61,6 +61,11 @@ class ReplicaAutoscaler:
         ``depth_high // 4``).
       p99_high_s: optional latency SLO — a ``serve.predict_s`` p99
         above it scales up even without a ramp.
+      slo_signal: consume the SLO plane (round 22): any objective the
+        default ``slo`` registry reports as burning past the
+        multi-window thresholds counts as scale-up evidence alongside
+        the ramp — inert unless ``DK_SLO`` is armed (``slo.breaching``
+        returns ``[]`` when off).  Default True.
       samples: ring points the ramp test inspects.
       clear_checks: consecutive calm ticks before a scale-down.
       cooldown_checks: ticks held still after any resize.
@@ -69,7 +74,8 @@ class ReplicaAutoscaler:
 
     def __init__(self, engine, floor=1, ceiling=8, interval_s=1.0,
                  depth_high=16, depth_low=None, p99_high_s=None,
-                 samples=5, clear_checks=3, cooldown_checks=2, step=1):
+                 slo_signal=True, samples=5, clear_checks=3,
+                 cooldown_checks=2, step=1):
         if not 1 <= int(floor) <= int(ceiling):
             raise ValueError(
                 f"need 1 <= floor ({floor}) <= ceiling ({ceiling})")
@@ -82,6 +88,7 @@ class ReplicaAutoscaler:
                           else self.depth_high / 4.0)
         self.p99_high_s = (None if p99_high_s is None
                            else float(p99_high_s))
+        self.slo_signal = bool(slo_signal)
         self.samples = int(samples)
         self.clear_checks = int(clear_checks)
         self.cooldown_checks = int(cooldown_checks)
@@ -114,6 +121,21 @@ class ReplicaAutoscaler:
                       and w[-1] >= self.depth_high)
         return firing, float(w[-1])
 
+    def _slo_burning(self):
+        """Firing objective names from the SLO plane's last evaluation
+        — ``[]`` when ``slo_signal`` is off, ``DK_SLO`` is unarmed, or
+        no objective burns.  Best-effort: the scaler must keep working
+        on a process without the SLO plane."""
+        if not self.slo_signal:
+            return []
+        try:
+            from dist_keras_tpu.observability import slo
+
+            return slo.breaching()
+        # dklint: ignore[broad-except] a broken SLO plane degrades to ramp/p99 evidence only
+        except Exception:  # pragma: no cover - slo plane optional
+            return []
+
     def tick(self):
         """One decision: inspect the rings, maybe resize.  -> the
         action taken: ``"up"`` / ``"down"`` / ``None`` (held)."""
@@ -124,13 +146,15 @@ class ReplicaAutoscaler:
         p99 = metrics.histogram("serve.predict_s").summary()["p99"]
         slo_breach = (self.p99_high_s is not None and p99 is not None
                       and p99 > self.p99_high_s)
+        burning = self._slo_burning()
         cur = self._replicas()
-        if (ramp or slo_breach) and cur < self.ceiling:
+        if (ramp or slo_breach or burning) and cur < self.ceiling:
             self._calm_streak = 0
             return self._resize(min(self.ceiling, cur + self.step),
                                 "up", depth=depth, p99=p99,
-                                ramp=ramp, slo_breach=slo_breach)
-        if ramp or slo_breach:
+                                ramp=ramp, slo_breach=slo_breach,
+                                slo_objectives=burning or None)
+        if ramp or slo_breach or burning:
             self._calm_streak = 0  # pinned at the ceiling: no churn
             return None
         calm = depth is None or depth <= self.depth_low

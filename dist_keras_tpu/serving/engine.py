@@ -444,8 +444,14 @@ class ServingEngine:
                     self._outstanding -= len(reqs)
                     self._reg_pending.set(self._outstanding)
                 self._reg_completed.inc(len(reqs))
-                self._m_predict.observe(dt)
-                self._reg_predict.observe(dt)
+                # exemplar: the replica thread has no open span, so
+                # the batch's first request trace is passed explicitly
+                # — a scrape's bad predict percentile then links to a
+                # retained trace containing this very hop
+                ex = ((reqs[0].ctx.trace_id, reqs[0].ctx.span_id)
+                      if reqs and reqs[0].ctx is not None else None)
+                self._m_predict.observe(dt, exemplar=ex)
+                self._reg_predict.observe(dt, exemplar=ex)
                 events.emit("serve_predict", replica=rep.index,
                             n=len(reqs), rung=len(x), duration_s=dt)
                 if events.enabled():

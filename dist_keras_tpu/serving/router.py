@@ -639,6 +639,18 @@ class RouterServer(ThreadingHTTPServer):
         self._stop_probe()
         self._stop_listener()
         events.emit("serve_drain", role="router")
+        from dist_keras_tpu.observability import flight, timeseries
+
+        # same end-of-life telemetry contract as ServingServer.drain:
+        # flush undecided retention buffers (route.forward traces) and
+        # run one final sampler tick so an incident landing just
+        # before the drain still fires its SLO evaluation
+        flight.retain_flush()
+        sampler = timeseries.get_sampler()
+        if sampler is not None:
+            sampler.tick()
+            if sampler.watchdog is not None:
+                sampler.watchdog.quiesce()
 
     def _stop_probe(self):
         self._probe_stop.set()

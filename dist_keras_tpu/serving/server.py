@@ -310,12 +310,23 @@ class ServingServer(ThreadingHTTPServer):
         failure), never a connection parked in an unserviced backlog."""
         out = self.engine.drain(timeout_s=timeout_s)
         self._stop_listener()  # in-flight handler threads still finish
+        from dist_keras_tpu.observability import flight, timeseries
+
+        # flush in-flight retention buffers (no-op when off): a pod
+        # dying right after the drain must not take undecided traces
+        # with it
+        flight.retain_flush()
+        sampler = timeseries.get_sampler()
+        if sampler is not None:
+            # one FINAL tick before quiescing: the drain may land
+            # right after an incident, and without this pass the
+            # perf_sample / SLO evaluation / watchdog check that would
+            # have fired the alert dies with the pod (the round-22
+            # regression fix — same contract as stop(final_tick=True))
+            sampler.tick()
         # deliberate completion: the serve.* counters stop advancing
         # now — quiesce the watchdog so drained-quiet is not judged a
         # throughput stall by the still-running sampler
-        from dist_keras_tpu.observability import timeseries
-
-        sampler = timeseries.get_sampler()
         if sampler is not None and sampler.watchdog is not None:
             sampler.watchdog.quiesce()
         return out

@@ -33,6 +33,10 @@ The scenarios:
   load spike: a backend killed mid-spike is evicted within the stale
   window (connect-failure + heartbeat evidence), re-admitted after
   healing, zero silent drops and zero placements on an evicted host.
+- ``slo_burn`` — the SLO plane's multi-window burn-rate math on sim
+  time: a seeded mid-run error window must page inside the fault,
+  escalate to the fast class while errors flow, and clear exactly
+  once as the trailing hour dilutes.
 
 Scenario outcomes are *asserted* here (a violated invariant raises
 :class:`ScenarioFailed`), so a scenario that returns IS its own green
@@ -746,6 +750,101 @@ def router_failover(world, hosts=None, workdir=None):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def slo_burn(world, hosts=None, workdir=None):
+    """The SLO plane's multi-window burn-rate math driven on SIM time:
+    seeded modeled serving traffic with a mid-run error window.  The
+    page must fire INSIDE the fault window and escalate to the FAST
+    class (5 m AND 1 h both >= 14.4x) while the errors still flow,
+    hold through the slow page's sustained-burn condition after they
+    stop, and clear exactly once — when the growing covered span
+    dilutes the hour-class burn below 6x.  (The burst is kept short —
+    90 s at 20% — so the whole arc fits inside the simulator's default
+    3600 s horizon.)  Transition-only: one fire, one clear.  Pure
+    ring-time math over a private registry, so two runs with the same
+    seed produce bit-identical digests."""
+    from dist_keras_tpu.observability import slo
+
+    hosts = 8 if hosts is None else max(1, int(hosts))
+    rng = world.rng
+    tick = 10.0
+    t_fault0, t_fault1, t_end = 600.0, 690.0, 3400.0
+    err_frac = 0.2
+
+    counts = {"good": 0, "total": 0}
+    reg = slo.Registry()
+    reg.register(slo.Objective(
+        "serve_availability", 0.999,
+        lambda: (counts["good"], counts["total"]),
+        description="sim: modeled serving traffic"))
+    rule = slo.SLOBurnRate(registry=reg)
+
+    fires = clears = 0
+    fired_at = cleared_at = fast_at = None
+    fire_page = fire_objective = None
+    was_firing = False
+    while world.elapsed < t_end:
+        now = world.elapsed
+        in_fault = t_fault0 <= now < t_fault1
+        n = rng.randrange(4 * hosts, 6 * hosts + 1)
+        bad = (sum(1 for _ in range(n) if rng.random() < err_frac)
+               if in_fault else 0)
+        counts["total"] += n
+        counts["good"] += n - bad
+        firing, fields = rule.evaluate(now)
+        if firing and not was_firing:
+            fires += 1
+            fired_at = now
+            fire_page = fields["page"]
+            fire_objective = fields["objective"]
+            world.record("slo_fire", t_s=round(now, 6),
+                         objective=fields["objective"],
+                         page=fields["page"],
+                         burn_5m=fields["burn_5m"],
+                         burn_1h=fields["burn_1h"])
+        elif was_firing and not firing:
+            clears += 1
+            cleared_at = now
+            world.record("slo_clear", t_s=round(now, 6))
+        if firing and fast_at is None and fields["page"] == "fast":
+            fast_at = now  # the slow page's cold-start head start ends
+            world.record("slo_fast", t_s=round(now, 6))
+        was_firing = firing
+        world.advance(tick)
+
+    _require(fires == 1,
+             f"expected exactly one fire transition, got {fires}")
+    _require(clears == 1,
+             f"expected exactly one clear transition, got {clears}")
+    _require(t_fault0 <= fired_at <= t_fault1,
+             f"page fired at +{fired_at:.0f}s — outside the fault "
+             f"window [{t_fault0:.0f}, {t_fault1:.0f}]s")
+    _require(fire_objective == "serve_availability",
+             f"alert named {fire_objective!r}")
+    # cold start: the partial 1h/6h windows degrade to the covered
+    # span, so the SLOW page may trip first — but a hard burn must
+    # escalate to the fast page while the fault is still live
+    _require(fast_at is not None and fast_at <= t_fault1,
+             f"the fast page never tripped inside the fault window "
+             f"(fast_at={fast_at})")
+    _require(cleared_at > t_fault1,
+             f"cleared at +{cleared_at:.0f}s, inside the fault")
+    # the slow page holds until the covered span dilutes the burst
+    # (~18s of bad traffic) below 6x the 0.1% budget: t ~ 3000s
+    _require(cleared_at <= t_fault1 + 3600.0 + tick,
+             f"clear took until +{cleared_at:.0f}s — more than one "
+             f"1h window past the fault end")
+    _require(not reg.breaching(),
+             f"still breaching at the end: {reg.breaching()}")
+    return {"hosts": hosts,
+            "fired_at_s": round(fired_at, 6),
+            "fast_at_s": round(fast_at, 6),
+            "cleared_at_s": round(cleared_at, 6),
+            "page": fire_page, "objective": fire_objective,
+            "requests": counts["total"],
+            "errors": counts["total"] - counts["good"],
+            "sleeps": world.sleeps}
+
+
 SCENARIOS = {
     "ps_churn": ps_churn,
     "partition_heal": partition_heal,
@@ -753,4 +852,5 @@ SCENARIOS = {
     "relaunch_waves": relaunch_waves,
     "gc_race": gc_race,
     "router_failover": router_failover,
+    "slo_burn": slo_burn,
 }

@@ -212,6 +212,43 @@ _register("DK_TRACE_RING", 2048, int,
           "retained in memory per process, dumped on watchdog alerts, "
           "preemption, crash, or `/tracez`)")
 
+# observability: SLO plane + tail-based trace retention (round 22)
+_register("DK_SLO", False, _parse_bool, kind="bool",
+          doc="`1` arms the request-level SLO plane: the default "
+              "serving objectives register, every sampler tick "
+              "evaluates multi-window burn rates, the `slo_burn_rate` "
+              "watchdog rule joins the default set, histograms "
+              "capture trace exemplars, and `/slz` appears in "
+              "`/statusz`")
+_register("DK_SLO_LATENCY_S", 0.5, float, kind="seconds",
+          doc="latency-objective threshold: a `serve.request` span "
+              "slower than this is a bad event for the "
+              "`serve_latency` objective (also the default "
+              "slow-request bar for tail-based trace retention)")
+_register("DK_TRACE_SAMPLE", 0.0, float,
+          kind="fraction",
+          doc="head-sampling rate in [0, 1] for tail-based retention: "
+              "this fraction of HEALTHY traces is kept anyway "
+              "(decided by a pure hash of the trace id, so replays "
+              "keep the same traces)")
+_register("DK_TRACE_RETAIN", False, _parse_bool, kind="bool",
+          doc="`1` arms tail-based trace retention: per-request span "
+              "records are buffered per trace and only written to "
+              "the event log when the request ends slow (over the "
+              "retention bar), errored, or head-sampled "
+              "(`DK_TRACE_SAMPLE`) — steady healthy traffic stops "
+              "growing the log linearly while every incident keeps "
+              "its trace")
+_register("DK_TRACE_RETAIN_SLOW_S", None, float, kind="seconds",
+          doc="retention slow-request bar: a root request span at "
+              "least this slow is always retained; unset = follow "
+              "`DK_SLO_LATENCY_S`")
+_register("DK_TRACE_RETAIN_BUDGET", 256, int,
+          "max in-flight traces buffered by the retention policy; "
+          "past the budget the OLDEST buffer is flushed to the log "
+          "(fail open — pressure can only make retention keep more, "
+          "never lose an incident trace)")
+
 # observability: telemetry plane
 _register("DK_OBS_SAMPLE_S", None, float, kind="seconds",
           doc="metrics-sampler cadence; unset = no sampler thread, no "

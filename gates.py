@@ -1076,6 +1076,238 @@ finish(resizes=a.resizes, replicas=eng.stats()["replicas"])
 """
 
 
+# The SLO gate's worker (round 22): a router fronting a 2-host pod
+# where ONE host is armed with a serve.predict delay fault.  Both
+# backends run the full SLO plane (DK_SLO + tail-based retention +
+# the 0.25s sampler).  The worker drives routed load, scrapes both
+# backends' prometheus endpoints (exemplars included), SIGTERMs the
+# pod so drain runs the final sampler tick + retention flush, then
+# checks the merged event log: slo_burn_rate pages the slow rank and
+# names the objective, the healthy rank stays alert-free, every
+# scrape exemplar over the bar resolves to a retained trace, the
+# healthy rank's traces were dropped (sublinear retention), and the
+# critical-path report pins the injected delay on the replica stage
+# of the faulted rank.
+_SLO_WORKER = r"""
+import os, sys, json, re, signal, subprocess, time
+work = sys.argv[1]
+os.environ["DK_OBS_DIR"] = os.path.join(work, "obs")
+os.environ["DK_COORD_RANK"] = "7"   # the router's rank in the log
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %REPO%)
+import urllib.error, urllib.request
+import numpy as np
+from dist_keras_tpu.observability import report, trace_export
+from dist_keras_tpu.serving import RouterServer
+
+failures = []
+
+def check(cond, msg):
+    if not cond:
+        failures.append(msg)
+
+def finish(**detail):
+    print("SLO_RESULT " + json.dumps(
+        {"ok": not failures, "failures": failures, **detail}),
+        flush=True)
+    sys.exit(0 if not failures else 1)
+
+SLOW_BAR = 0.05   # DK_SLO_LATENCY_S: the latency objective's bar
+DELAY = 0.2       # the injected serve.predict delay on rank 1
+N_REQ = 40
+
+_BACKEND_SRC = '''
+import os, signal, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dist_keras_tpu.models import mnist_mlp
+from dist_keras_tpu.serving import ServingEngine, ServingServer
+
+port, port_file = int(sys.argv[1]), sys.argv[2]
+model = mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+eng = ServingEngine(model, replicas=1, batch_ladder=(1, 8),
+                    max_latency_s=0.001, max_queue=1024)
+rng = np.random.default_rng(0)
+rows = rng.normal(size=(8, 4)).astype(np.float32)
+for r in (1, 8):
+    eng.predict(rows[:r], timeout_s=120)  # warm the ladder pre-listen
+srv = ServingServer(eng, port=port)
+srv.start()
+stopping = []
+signal.signal(signal.SIGTERM, lambda s, f: stopping.append(s))
+with open(port_file + ".tmp", "w") as f:
+    f.write(str(srv.address[1]))
+os.replace(port_file + ".tmp", port_file)  # port publish is atomic
+while not stopping:
+    time.sleep(0.05)
+srv.drain()       # final sampler tick + retention flush happen HERE
+srv.close()
+eng.close()
+sys.exit(0)
+'''
+bpath = os.path.join(work, "backend.py")
+with open(bpath, "w") as f:
+    f.write(_BACKEND_SRC)
+
+def spawn(rank, faulted):
+    pf = os.path.join(work, "port_b%d" % rank)
+    env = dict(os.environ)
+    env["DK_COORD_RANK"] = str(rank)
+    env["DK_SLO"] = "1"
+    env["DK_TRACE_RETAIN"] = "1"
+    env["DK_SLO_LATENCY_S"] = str(SLOW_BAR)
+    env["DK_OBS_SAMPLE_S"] = "0.25"
+    if faulted:
+        env["DK_FAULTS"] = ("serve.predict@0x100000:"
+                            "action=delay,value=%s" % DELAY)
+    p = subprocess.Popen([sys.executable, bpath, "0", pf],
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL, env=env)
+    t0 = time.monotonic()
+    while not os.path.exists(pf):
+        if p.poll() is not None:
+            raise RuntimeError(
+                "backend %d died rc=%s" % (rank, p.returncode))
+        if time.monotonic() - t0 > 180:
+            p.kill()
+            raise RuntimeError("backend %d startup timed out" % rank)
+        time.sleep(0.05)
+    with open(pf) as f:
+        return p, int(f.read())
+
+p0, port0 = spawn(0, faulted=False)
+p1, port1 = spawn(1, faulted=True)
+srv = RouterServer(["127.0.0.1:%d" % port0, "127.0.0.1:%d" % port1],
+                   port=0, probe_s=0.25, forward_timeout_s=30.0)
+host, rport = srv.start()
+
+rng = np.random.default_rng(0)
+body = json.dumps(
+    {"rows": rng.normal(size=(1, 4)).astype(np.float32).tolist()}
+).encode("utf-8")
+client_traces = set()
+n200 = 0
+for i in range(N_REQ):
+    trace = format(0x51000000 + i, "032x")
+    client_traces.add(trace)
+    req = urllib.request.Request(
+        "http://%s:%d/predict" % (host, rport), data=body,
+        method="POST",
+        headers={"Content-Type": "application/json",
+                 "traceparent": "00-%s-00000000000000ab-01" % trace})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+            n200 += resp.status == 200
+    except urllib.error.HTTPError:
+        pass
+check(n200 >= int(0.9 * N_REQ), "only %d/%d requests served"
+      % (n200, N_REQ))
+time.sleep(0.8)  # a few more sampler ticks past the last request
+
+def scrape(port):
+    url = "http://127.0.0.1:%d/metricsz?format=prometheus" % port
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read().decode("utf-8")
+
+text0, text1 = scrape(port0), scrape(port1)
+
+def counter(text, name):
+    m = re.search(r"^%s\{[^}]*\} ([0-9.eE+-]+)$" % re.escape(name),
+                  text, re.M)
+    return float(m.group(1)) if m else None
+
+req0 = counter(text0, "dk_span_serve_request_count") or 0
+req1 = counter(text1, "dk_span_serve_request_count") or 0
+# the depth-aware router steers AWAY from the slow backend (that is
+# the policy working), so only a handful of requests reach rank 1 —
+# enough to burn its latency objective, not an even split
+check(req0 >= 3 and req1 >= 3,
+      "load not spread: %s vs %s serve.request" % (req0, req1))
+
+# exemplars in the slow rank's scrape: trace ids over the bar
+exemplars = re.findall(
+    r'^# \{[^}]*trace_id="([0-9a-f]{32})"[^}]*\} ([0-9.eE+-]+)$',
+    text1, re.M)
+slow_ex = {t for t, v in exemplars if float(v) >= SLOW_BAR}
+check(len(slow_ex) >= 1, "no over-bar exemplars in the rank-1 scrape")
+
+for p in (p0, p1):
+    p.terminate()
+rcs = [p.wait(timeout=120) for p in (p0, p1)]
+srv.close()
+check(rcs == [0, 0], "backend drain rcs=%s" % rcs)
+
+recs = report.read_events(os.environ["DK_OBS_DIR"])
+
+# (a) the burn-rate page names the slow rank and the objective; the
+# healthy rank never pages
+alerts = [r for r in recs if r.get("kind") == "watchdog_alert"
+          and r.get("rule") == "slo_burn_rate"]
+slow_pages = [a for a in alerts if a.get("rank") == 1]
+check(any(a.get("objective") == "serve_latency" for a in slow_pages),
+      "no slo_burn_rate page naming serve_latency on rank 1: %s"
+      % [(a.get("rank"), a.get("objective")) for a in alerts])
+check(all(a.get("page") in ("fast", "slow") for a in slow_pages),
+      "page severity missing from the alert")
+check(not [a for a in alerts if a.get("rank") == 0],
+      "healthy rank 0 paged: %s" % [a.get("objective") for a in alerts
+                                    if a.get("rank") == 0])
+
+# (b) tail-based retention: every breaching rank-1 request kept a
+# complete trace; the healthy rank's fast traces were dropped
+ends = [r for r in recs if r.get("kind") == "span_end"]
+kept1 = {r["trace_id"] for r in ends
+         if r.get("rank") == 1 and r.get("span") == "serve.request"
+         and r.get("trace_id") in client_traces}
+kept0 = {r["trace_id"] for r in ends
+         if r.get("rank") == 0 and r.get("span") == "serve.request"
+         and r.get("trace_id") in client_traces}
+check(len(kept1) >= int(0.9 * req1),
+      "breaching traces lost: %d retained of %s routed"
+      % (len(kept1), req1))
+check(len(kept0) <= max(2, int(0.1 * req0)),
+      "healthy-rank retention not sublinear: %d of %s kept"
+      % (len(kept0), req0))
+retained1 = counter(text1, "dk_trace_retained_total") or 0
+dropped0 = counter(text0, "dk_trace_dropped_total") or 0
+check(retained1 >= 1, "rank 1 counted no retained traces")
+check(dropped0 >= 1, "rank 0 counted no dropped traces")
+
+# (c) every over-bar scrape exemplar resolves to a retained trace
+unresolved = [t for t in slow_ex
+              if not any(r.get("trace_id") == t for r in ends)]
+check(not unresolved,
+      "exemplars with no retained trace: %s" % unresolved[:3])
+
+# (d) the critical path pins the delay on the faulted rank's replica
+# stage, reached from the router's forward hop
+paths = trace_export.request_paths(
+    [r for r in recs if r.get("trace_id") in kept1], worst=3)
+check(len(paths) >= 1, "no critical paths over the retained traces")
+for cp in paths[:1]:
+    crit = cp["critical"]
+    check(crit["rank"] == 1,
+          "critical hop on rank %s, not the faulted rank" % crit["rank"])
+    check(crit["category"] == "replica_compute",
+          "critical hop %s (%s), not replica_compute"
+          % (crit["span"], crit["category"]))
+    check(crit["self_s"] >= 0.8 * DELAY,
+          "critical self-time %.3fs misses the %.1fs delay"
+          % (crit["self_s"], DELAY))
+    check(any(h["category"] == "forward_hop" for h in cp["path"]),
+          "path never crossed the router hop")
+
+finish(n200=n200, req0=req0, req1=req1, retained=len(kept1),
+       dropped_rank0=int(dropped0), exemplars=len(slow_ex),
+       pages=len(slow_pages))
+"""
+
+
 # The chaos gate's 2-process worker: the coordinated-preemption
 # choreography (votes, agreements, two-phase saves, barriers) driven
 # for several rounds under a SEEDED random fault schedule
@@ -2436,6 +2668,70 @@ def run_router_gate(timeout=420):
     }
 
 
+def run_slo_gate(timeout=420):
+    """-> gate record for the request-level SLO engine (round 22, see
+    _SLO_WORKER): a router + 2-host pod with one host's serve.predict
+    delayed fires slo_burn_rate naming the objective and the slow rank
+    while the healthy rank stays alert-free; scrape exemplars resolve
+    to retained traces; tail-based retention drops the healthy rank's
+    traces (sublinear) while keeping every breaching one; and the
+    critical-path report pins the delay on the faulted rank's replica
+    stage."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_slo_gate_")
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_SLO_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_SERVE", "DK_ROUTE", "DK_ALERT",
+                                     "DK_SLO", "DK_TRACE"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    detail = {}
+    t0 = time.time()
+    try:
+        p = subprocess.Popen([sys.executable, script, work],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT,
+                             env=base_env, text=True)
+        try:
+            out = p.communicate(timeout=timeout)[0]
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = p.communicate()[0]
+            failures.append(f"HANG (killed at {timeout}s)")
+            out = out or ""
+        m = re.search(r"^SLO_RESULT (\{.*\})$", out, re.M)
+        if m:
+            doc = json.loads(m.group(1))
+            detail = {k: v for k, v in doc.items()
+                      if k not in ("ok", "failures")}
+            failures.extend(doc.get("failures", []))
+            if p.returncode != 0 and not doc.get("failures"):
+                failures.append(f"rc={p.returncode}")
+        elif not failures:
+            failures.append(f"no SLO_RESULT (rc={p.returncode}): "
+                            f"{out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "slo",
+        "metric": "burn_page_exemplars_retention_critical_path",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "detail": detail,
+        "failures": failures,
+    }
+
+
 def _run_obs_pair(script, base_env, work, name, obs_dir, timeout):
     """Launch the 2-rank worker; -> (rcs, outs, rank-0 stats, hung)."""
     coord_dir = os.path.join(work, name, "coord")
@@ -3507,9 +3803,9 @@ def run_sim_gate(timeout=600):
     churn with kills/rejoins + a healed partition, focused partition
     heal, preemption storm, elastic relaunch waves, checkpoint GC
     races, router failover under a load spike), the churn run under
-    its 60s wall budget, and second seeded runs of ``ps_churn`` AND
-    ``router_failover`` replaying BIT-IDENTICALLY (trace digest
-    equality across separate processes)."""
+    its 60s wall budget, and second seeded runs of ``ps_churn``,
+    ``router_failover`` AND ``slo_burn`` replaying BIT-IDENTICALLY
+    (trace digest equality across separate processes)."""
     t0 = time.time()
     failures = []
     detail = {}
@@ -3597,6 +3893,22 @@ def run_sim_gate(timeout=600):
                     "router_failover replay diverged: "
                     f"{rf.get('digest', '')[:16]} != "
                     f"{rf2.get('digest', '')[:16]}")
+        sb = next((r for r in doc.get("scenarios", [])
+                   if r.get("scenario") == "slo_burn"), None)
+        if sb is None or "error" in sb:
+            failures.append("slo_burn produced no verdict")
+        else:
+            proc4, doc4 = _cli("--scenario", "slo_burn", "--seed", "0")
+            sb2 = (doc4.get("scenarios") or [{}])[0]
+            detail["slo_replay"] = {
+                "digest": sb2.get("digest", "")[:16],
+                "matches": sb2.get("digest") == sb.get("digest"),
+            }
+            if sb2.get("digest") != sb.get("digest"):
+                failures.append(
+                    "slo_burn replay diverged: "
+                    f"{sb.get('digest', '')[:16]} != "
+                    f"{sb2.get('digest', '')[:16]}")
     except subprocess.TimeoutExpired:
         failures.append(f"HANG (killed at {timeout}s)")
     except (ValueError, KeyError) as e:
@@ -3661,6 +3973,16 @@ def main():
                          "traces, blue/green cutover under load, "
                          "autoscaler actuation/hysteresis) and print "
                          "its record")
+    ap.add_argument("--slo-only", action="store_true",
+                    help="run just the request-level SLO gate (router "
+                         "+ 2-host pod, one host's serve.predict "
+                         "delayed -> slo_burn_rate pages naming the "
+                         "objective and the slow rank, healthy rank "
+                         "alert-free, scrape exemplars resolve to "
+                         "retained traces, sublinear tail-based "
+                         "retention, critical-path report pins the "
+                         "delay on the faulted replica stage) and "
+                         "print its record")
     ap.add_argument("--chaos-only", action="store_true",
                     help="run just the self-healing chaos gate (K "
                          "seeded randomized-fault 2-process runs + "
@@ -3767,6 +4089,11 @@ def main():
         print(json.dumps(route_gate, indent=1))
         return 0 if route_gate["passed"] else 1
 
+    if args.slo_only:
+        slo_gate = run_slo_gate()
+        print(json.dumps(slo_gate, indent=1))
+        return 0 if slo_gate["passed"] else 1
+
     if args.obs_only:
         obs_gate = run_obs_gate()
         print(json.dumps(obs_gate, indent=1))
@@ -3782,6 +4109,7 @@ def main():
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_router_gate())
+    res["gates"].append(run_slo_gate())
     res["gates"].append(run_chaos_gate())
     res["gates"].append(run_diff_ckpt_gate())
     res["gates"].append(run_elastic_gate())

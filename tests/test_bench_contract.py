@@ -46,8 +46,8 @@ def test_zero_budget_still_yields_complete_record():
     # + CPU ckpt-manifest overhead + CPU ckpt-async-save
     # + CPU diff-ckpt + CPU retrace-proxy attribution
     # + CPU reshard-restore + CPU comm-overlap proxy
-    # + CPU ps-compress + CPU sim-swarm
-    assert len(rec["configs"]) == 19
+    # + CPU ps-compress + CPU sim-swarm + CPU slo-overhead
+    assert len(rec["configs"]) == 20
     assert all(c.get("skipped") == "budget" for c in rec["configs"])
     # driver-contract top-level keys exist even with no headline run
     for key in ("metric", "value", "unit", "vs_baseline"):

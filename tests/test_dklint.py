@@ -411,6 +411,37 @@ def test_span_dynamic_annotation_and_span_at(tmp_path):
     assert "nope" in fs[0].message
 
 
+# -- registry rules: SLO objectives ------------------------------------
+
+SLOS_FIXTURE = """
+    KNOWN_SLOS = {"serve_availability": "answered without error",
+                  "serve_latency": "under the latency threshold"}
+"""
+
+
+def test_slo_doc_sync(tmp_path):
+    readme = """
+        <!-- dklint: slos-table -->
+        | objective | meaning |
+        |---|---|
+        | `serve_availability` | answered |
+        | `phantom_slo` | nowhere |
+    """
+    fs = lint(tmp_path, {"slo.py": SLOS_FIXTURE}, readme=readme,
+              rules=["slo-undocumented", "slo-doc-drift"])
+    got = {(f.rule, "serve_latency" in f.message,
+            "phantom_slo" in f.message) for f in fs}
+    assert got == {("slo-undocumented", True, False),
+                   ("slo-doc-drift", False, True)}
+
+
+def test_slo_table_marker_required(tmp_path):
+    fs = lint(tmp_path, {"slo.py": SLOS_FIXTURE},
+              readme="no tables here\n",
+              rules=["slo-undocumented"])
+    assert len(fs) == 1 and "marker" in fs[0].message
+
+
 def test_syntax_error_rule_survives_rules_filter(tmp_path):
     (tmp_path / "broken.py").write_text("def f(:\n")
     (tmp_path / "ok.py").write_text("x = 1\n")
@@ -1469,6 +1500,8 @@ def test_rule_docs_complete():
         "metric-undocumented", "metric-doc-drift",
         # round 16: the span-vocabulary registry
         "span-unregistered", "span-dynamic",
+        # round 22: the SLO-objective registry
+        "slo-undocumented", "slo-doc-drift",
         "signal-unsafe",
         "obs-must-not-raise", "broad-except", "untyped-raise",
         "jit-impure",

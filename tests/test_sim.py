@@ -252,4 +252,5 @@ def test_cli_last_stdout_line_is_the_json_contract():
 def test_scenario_registry_matches_cli_choices():
     assert SCENARIOS.keys() == {"ps_churn", "partition_heal",
                                 "preemption_storm", "relaunch_waves",
-                                "gc_race", "router_failover"}
+                                "gc_race", "router_failover",
+                                "slo_burn"}

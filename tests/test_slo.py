@@ -1,0 +1,531 @@
+"""SLO engine (round 22): multi-window burn-rate math, the
+slo_burn_rate watchdog rule, trace exemplars, tail-based trace
+retention, critical-path attribution, and the drain-time final tick."""
+
+import json
+import time
+
+import pytest
+
+from dist_keras_tpu.observability import (
+    events,
+    flight,
+    metrics,
+    prometheus,
+    report,
+    slo,
+    spans,
+    statusz,
+    timeseries,
+    trace_export,
+    watchdog,
+)
+
+
+def _reset_all():
+    events.reset()
+    metrics.reset()
+    flight.reset()
+    spans.reset()
+    timeseries.reset()
+    slo.reset()
+
+
+@pytest.fixture
+def slo_env(tmp_path, monkeypatch):
+    """DK_SLO armed + event log into a temp dir, full reset both ways."""
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    monkeypatch.setenv("DK_SLO", "1")
+    _reset_all()
+    yield d
+    _reset_all()
+
+
+@pytest.fixture
+def clean(monkeypatch):
+    """No env, clean registries — for ring-time math tests."""
+    monkeypatch.delenv("DK_OBS_DIR", raising=False)
+    monkeypatch.delenv("DK_SLO", raising=False)
+    _reset_all()
+    yield
+    _reset_all()
+
+
+def _scripted(counts):
+    """An Objective over a mutable {"good", "total"} dict."""
+    return slo.Objective(
+        "serve_availability", 0.999,
+        lambda: (counts["good"], counts["total"]))
+
+
+# ------------------------------------------------------- burn-rate math
+def test_healthy_traffic_never_burns(clean):
+    c = {"good": 0, "total": 0}
+    obj = _scripted(c)
+    for i in range(60):
+        c["good"] += 100
+        c["total"] += 100
+        doc = obj.evaluate(i * 10.0)
+    assert doc["burn"] == {"5m": 0.0, "1h": 0.0, "6h": 0.0}
+    assert not doc["firing"]
+
+
+def test_hard_burn_fires_fast_page(clean):
+    c = {"good": 0, "total": 0}
+    obj = _scripted(c)
+    # 20% errors against a 99.9% target: burn = 0.2 / 0.001 = 200
+    for i in range(40):
+        c["good"] += 80
+        c["total"] += 100
+        doc = obj.evaluate(i * 10.0)
+    assert doc["burn"]["5m"] == pytest.approx(200.0)
+    assert doc["fast_firing"] and doc["firing"]
+
+
+def test_burn_window_excludes_old_errors(clean):
+    c = {"good": 0, "total": 0}
+    obj = _scripted(c)
+    # errors only in the first 100s, then clean for well over 5m
+    for i in range(100):
+        bad = 20 if i < 10 else 0
+        c["good"] += 100 - bad
+        c["total"] += 100
+        doc = obj.evaluate(i * 10.0)
+    # the 5m window [670, 970] saw zero errors; 1h still covers them
+    assert doc["burn"]["5m"] == 0.0
+    assert doc["burn"]["1h"] > 0.0
+    assert not doc["fast_firing"]
+
+
+def test_partial_window_degrades_to_covered_span(clean):
+    c = {"good": 0, "total": 100}
+    obj = _scripted(c)
+    obj.evaluate(0.0)
+    c["total"] = 200  # second sample: 100 more requests, all bad
+    doc = obj.evaluate(10.0)
+    # 10s of data, but every window reads the covered span: 100% bad
+    for label in ("5m", "1h", "6h"):
+        assert doc["burn"][label] == pytest.approx(1000.0)
+    assert doc["firing"]
+
+
+def test_objective_vocabulary_is_closed(clean):
+    with pytest.raises(ValueError, match="KNOWN_SLOS"):
+        slo.Objective("made_up_slo", 0.99, lambda: (0, 0))
+    with pytest.raises(ValueError, match="target"):
+        slo.Objective("serve_latency", 1.5, lambda: (0, 0))
+
+
+def test_registry_rejects_duplicates_and_is_idempotent(clean):
+    reg = slo.Registry()
+    c = {"good": 0, "total": 0}
+    obj = reg.register(_scripted(c))
+    with pytest.raises(ValueError, match="already"):
+        reg.register(_scripted(c))
+    c["good"] = c["total"] = 100
+    reg.evaluate(10.0)
+    reg.evaluate(10.0)  # same instant: no second ring append
+    reg.evaluate(5.0)   # time going backwards: ignored too
+    assert len(obj._t) == 1
+    assert [r["objective"] for r in reg.results()] \
+        == ["serve_availability"]
+
+
+# ------------------------------------------ the watchdog rule + signal
+def test_burn_rule_names_worst_objective(clean):
+    reg = slo.Registry()
+    avail = {"good": 0, "total": 0}
+    lat = {"good": 0, "total": 0}
+    reg.register(_scripted(avail))
+    reg.register(slo.Objective(
+        "serve_latency", 0.99, lambda: (lat["good"], lat["total"])))
+    rule = slo.SLOBurnRate(registry=reg)
+    for i in range(40):
+        avail["good"] += 100          # healthy
+        avail["total"] += 100
+        lat["good"] += 50             # 50% over threshold
+        lat["total"] += 100
+        firing, fields = rule.evaluate(i * 10.0)
+    assert firing
+    assert fields["objective"] == "serve_latency"
+    assert fields["objectives"] == ["serve_latency"]
+    assert fields["page"] == "fast"
+    assert fields["burn_5m"] >= slo.FAST_BURN
+
+
+def test_burn_rule_transitions_under_watchdog(clean):
+    reg = slo.Registry()
+    c = {"good": 0, "total": 0}
+    reg.register(_scripted(c))
+    wd = watchdog.Watchdog(rules=[slo.SLOBurnRate(registry=reg)])
+    alerts = []
+    wd.alert_sink = lambda a: alerts.append(a)
+    for i in range(40):
+        c["good"] += 50
+        c["total"] += 100
+        wd.check(now=i * 10.0)
+    # transition-only: one alert despite ~38 firing ticks
+    assert len(alerts) == 1
+    assert alerts[0]["rule"] == "slo_burn_rate"
+    assert alerts[0]["objective"] == "serve_availability"
+
+
+def test_default_rules_append_burn_rule_only_when_armed(monkeypatch):
+    monkeypatch.delenv("DK_SLO", raising=False)
+    slo.reset()
+    assert not any(r.name == "slo_burn_rate"
+                   for r in watchdog.default_rules())
+    monkeypatch.setenv("DK_SLO", "1")
+    slo.reset()
+    rules = watchdog.default_rules()
+    assert any(r.name == "slo_burn_rate" for r in rules)
+    slo.reset()
+
+
+def test_breaching_feeds_autoscaler_shape(slo_env):
+    slo.install_defaults()
+    assert slo.breaching() == []
+    # make the default latency objective burn via its real histogram
+    h = metrics.histogram("span.serve.request")
+    t0 = time.time()
+    for i in range(2):
+        for _ in range(50):
+            h.observe(9.0)  # way over any threshold
+        slo._default.evaluate(t0 + i * 10.0)
+    assert "serve_latency" in slo.breaching()
+
+
+def test_latency_objective_counts_over_threshold(clean):
+    obj = slo.latency("serve_latency", threshold_s=0.1, target=0.99)
+    h = metrics.histogram("span.serve.request")
+    for v in (0.01, 0.02, 0.5, 0.9):
+        h.observe(v)
+    good, total = obj.source()
+    assert (good, total) == (2.0, 4.0)
+    assert obj.threshold_s == 0.1
+
+
+def test_statusz_has_slz_section(slo_env):
+    doc = statusz.status_doc()
+    assert doc["slz"]["enabled"] is True
+    assert doc["slz"]["windows"] == {"5m": 300.0, "1h": 3600.0,
+                                     "6h": 21600.0}
+
+
+# ------------------------------------------------------ trace exemplars
+def test_exemplar_captured_under_open_span(slo_env):
+    with spans.span("serve.request"):
+        metrics.histogram("span.serve.request").observe(0.7)
+    snap = metrics.snapshot(percentiles=True)
+    ex = snap["histograms"]["span.serve.request"]["exemplars"]
+    # the span exit auto-observes its own duration too
+    mine = [e for e in ex if e["value"] == 0.7]
+    assert len(mine) == 1
+    assert len(mine[0]["trace_id"]) == 32
+    assert len(mine[0]["span_id"]) == 16
+
+
+def test_exemplar_rendered_in_prometheus_exposition(slo_env):
+    with spans.span("serve.request"):
+        metrics.histogram("span.serve.request").observe(0.7)
+    text = prometheus.render(metrics.snapshot(percentiles=True))
+    line = next(l for l in text.splitlines() if l.startswith("# {"))
+    assert 'trace_id="' in line and 'span_id="' in line
+    assert line.endswith(" 0.7")
+
+
+def test_no_exemplars_when_slo_unarmed(tmp_path, monkeypatch):
+    monkeypatch.setenv("DK_OBS_DIR", str(tmp_path / "obs"))
+    monkeypatch.delenv("DK_SLO", raising=False)
+    _reset_all()
+    try:
+        with spans.span("serve.request"):
+            metrics.histogram("span.serve.request").observe(0.7)
+        snap = metrics.snapshot(percentiles=True)
+        assert "exemplars" not in snap["histograms"]["span.serve.request"]
+    finally:
+        _reset_all()
+
+
+def test_exemplar_ring_is_bounded(slo_env):
+    h = metrics.histogram("span.serve.request")
+    with spans.span("serve.request"):
+        for i in range(3 * h.EXEMPLARS):
+            h.observe(float(i))
+    ex = h.exemplars()
+    assert len(ex) == h.EXEMPLARS
+    # newest observations win (the very last is the span's own exit)
+    assert float(3 * h.EXEMPLARS - 1) in [e["value"] for e in ex]
+
+
+# ------------------------------------------------- tail-based retention
+@pytest.fixture
+def retain_env(tmp_path, monkeypatch):
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    monkeypatch.setenv("DK_SLO", "1")
+    monkeypatch.setenv("DK_TRACE_RETAIN", "1")
+    monkeypatch.setenv("DK_TRACE_RETAIN_SLOW_S", "0.05")
+    _reset_all()
+    yield d
+    _reset_all()
+
+
+def test_retention_keeps_slow_drops_fast(retain_env):
+    for _ in range(5):
+        with spans.span("serve.request"):
+            pass  # fast + healthy: dropped
+    with spans.span("serve.request"):
+        time.sleep(0.06)  # over the 0.05s bar: retained
+    recs = report.read_events(retain_env)
+    ends = [e for e in recs if e.get("kind") == "span_end"]
+    assert len(ends) == 1
+    assert ends[0]["duration_s"] >= 0.05
+    snap = metrics.snapshot()
+    assert snap["counters"]["trace.retained"] == 1
+    assert snap["counters"]["trace.dropped"] == 5
+    assert snap["counters"]["trace.dropped_records"] == 10
+
+
+def test_retention_keeps_errored_requests(retain_env):
+    with spans.span("serve.request"):
+        events.emit("serve_batch_error", error="Boom", n=1)
+    recs = report.read_events(retain_env)
+    kinds = [e["kind"] for e in recs]
+    assert "serve_batch_error" in kinds and "span_end" in kinds
+
+
+def test_retention_head_sampling_is_deterministic(retain_env,
+                                                  monkeypatch):
+    monkeypatch.setenv("DK_TRACE_SAMPLE", "1.0")
+    _reset_all()
+    with spans.span("serve.request"):
+        pass  # fast + healthy, but sample=1.0 keeps everything
+    recs = report.read_events(retain_env)
+    assert any(e.get("kind") == "span_end" for e in recs)
+
+
+def test_retention_budget_flushes_oldest_never_drops(retain_env):
+    writes = []
+
+    class W:
+        def write(self, rec):
+            writes.append(rec)
+
+    r = flight.TraceRetention(slow_s=10.0, sample=0.0, budget=2)
+    w = W()
+    for i in range(3):
+        assert r.offer({"kind": "span_begin", "trace_id": f"t{i}",
+                        "span_id": f"s{i}", "t": float(i)}, w)
+    # third trace evicted the OLDEST buffer (t0) to the log: fail open
+    assert [rec["trace_id"] for rec in writes] == ["t0"]
+    assert r.stats()["inflight"] == 2
+    # undecided buffers flush on demand (drain / incident dump)
+    assert r.flush_all() == 2
+    assert {rec["trace_id"] for rec in writes} == {"t0", "t1", "t2"}
+    assert r.stats()["inflight"] == 0
+
+
+def test_retained_records_keep_original_timestamps(retain_env):
+    with spans.span("serve.request"):
+        events.emit("serve_enqueue", pending=1)
+        time.sleep(0.06)
+    recs = report.read_events(retain_env)
+    kinds = [e["kind"] for e in recs]
+    # written at request end, but merged back in true (t, seq) order
+    assert kinds.index("span_begin") < kinds.index("serve_enqueue") \
+        < kinds.index("span_end")
+    ts = [e["t"] for e in recs]
+    assert ts == sorted(ts)
+
+
+def test_non_request_events_pass_through(retain_env):
+    events.emit("train_start", trainer="t")  # not a retain kind
+    recs = report.read_events(retain_env)
+    assert [e["kind"] for e in recs] == ["train_start"]
+
+
+def test_flight_dump_flushes_inflight_buffers(retain_env):
+    sp = spans.span("serve.request")
+    sp.__enter__()
+    try:
+        assert flight.retention().stats()["inflight"] == 1
+        flight.dump("on_demand")
+        recs = report.read_events(retain_env)
+        assert any(e.get("kind") == "span_begin" for e in recs)
+    finally:
+        sp.__exit__(None, None, None)
+
+
+# ------------------------------------- drain-time final tick regression
+def test_drain_right_after_breach_still_pages(tmp_path, monkeypatch):
+    """A pod drained immediately after an SLO breach must not lose the
+    tick that fires the alert: ServingServer.drain runs one final
+    sampler tick (snapshot + SLO evaluation + watchdog + perf_sample)
+    before quiescing."""
+    import urllib.request
+
+    import numpy as np
+
+    from dist_keras_tpu.models import mnist_mlp
+    from dist_keras_tpu.serving import ServingEngine, ServingServer
+
+    def post(url, rows):
+        req = urllib.request.Request(
+            url, data=json.dumps({"rows": rows}).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    monkeypatch.setenv("DK_SLO", "1")
+    # every request breaches; the sampler cadence never ticks on its own
+    monkeypatch.setenv("DK_SLO_LATENCY_S", "0.000001")
+    monkeypatch.setenv("DK_OBS_SAMPLE_S", "3600")
+    _reset_all()
+    try:
+        m = mnist_mlp(hidden=(8,), input_dim=4, num_classes=3)
+        eng = ServingEngine(m, replicas=1, batch_ladder=(1, 4),
+                            max_latency_s=0.002, max_queue=64)
+        srv = ServingServer(eng, port=0)
+        host, port = srv.start()
+        url = f"http://{host}:{port}/predict"
+        sampler = timeseries.get_sampler()
+        assert sampler is not None
+        rows = np.zeros((2, 4), dtype=np.float32).tolist()
+        post(url, rows)
+        sampler.tick()            # baseline sample, nothing firing yet
+        assert slo.breaching() == []
+        post(url, rows)           # the breach
+        srv.drain()               # ... and the immediate drain
+        assert "serve_latency" in slo.breaching()
+        recs = report.read_events(d)
+        alerts = [e for e in recs if e.get("kind") == "watchdog_alert"
+                  and e.get("rule") == "slo_burn_rate"]
+        assert alerts and alerts[0]["objective"] == "serve_latency"
+        assert sum(1 for e in recs
+                   if e.get("kind") == "perf_sample") >= 2
+        srv.close()
+        eng.close()
+    finally:
+        _reset_all()
+
+
+# --------------------------------------- critical path + the SLO report
+def _span(rank, span, trace, sid, parent, t0, dur, **extra):
+    return {"kind": "span_end", "rank": rank, "tid": 1, "span": span,
+            "trace_id": trace, "span_id": sid, "parent_id": parent,
+            "t": t0 + dur, "t0": t0, "duration_s": dur, "seq": 0,
+            **extra}
+
+
+def _router_stitched_trace(trace="ab" * 16):
+    """client (rank 0) -> route.forward (rank 0) -> failed serve.request
+    (rank 1) + retried sibling serve.request (rank 2) -> serve.exec."""
+    return [
+        _span(0, "serve.client", trace, "a" * 16, None, 0.0, 0.50),
+        _span(0, "route.forward", trace, "b" * 16, "a" * 16,
+              0.01, 0.48),
+        _span(1, "serve.request", trace, "c" * 16, "b" * 16,
+              0.02, 0.05, error="ConnectionError"),
+        _span(2, "serve.request", trace, "d" * 16, "b" * 16,
+              0.08, 0.40),
+        _span(2, "serve.request.serve.exec", trace, "e" * 16, "d" * 16,
+              0.10, 0.30),
+    ]
+
+
+def test_router_stitched_trace_is_one_connected_tree():
+    recs = _router_stitched_trace()
+    (row,) = connected = trace_export.connected_traces(recs).values()
+    assert row["connected"] and row["orphans"] == []
+    assert row["roots"] == ["serve.client"]
+    assert row["ranks"] == [0, 1, 2]
+    # both the failed hop and its re-sent sibling link to the forward
+    assert row["cross_rank"] == 2
+
+
+def test_chrome_trace_over_stitched_trace_no_orphans():
+    recs = _router_stitched_trace()
+    doc = trace_export.chrome_trace(recs, instants=False)
+    evs = doc["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in slices} >= {"serve.client",
+                                           "route.forward",
+                                           "serve.request"}
+    # the retry hop is visible: two serve.request slices, two ranks
+    reqs = [e for e in slices if e["name"] == "serve.request"]
+    assert sorted(e["pid"] for e in reqs) == [1, 2]
+    # the two cross-host handoffs (forward -> rank 1, forward -> the
+    # rank-2 retry) draw flow arrows; same-rank edges don't need them
+    starts = [e for e in evs if e["ph"] == "s" and e["cat"] == "handoff"]
+    assert len(starts) == 2
+    finishes = [e for e in evs
+                if e["ph"] == "f" and e["cat"] == "handoff"]
+    assert len(finishes) == len(starts)
+    # the dominant chain renders as critical_path arrows
+    cps = [e for e in evs if e.get("cat") == "critical_path"]
+    assert cps and len(cps) % 2 == 0
+
+
+def test_critical_path_attributes_the_slow_hop():
+    cp = trace_export.critical_path(_router_stitched_trace())
+    assert cp["root"] == "serve.client"
+    assert cp["rank"] == 0
+    assert cp["total_s"] == pytest.approx(0.5)
+    assert [h["span"] for h in cp["path"]] == [
+        "serve.client", "route.forward", "serve.request",
+        "serve.request.serve.exec"]
+    assert cp["critical"]["span"] == "serve.request.serve.exec"
+    assert cp["critical"]["category"] == "replica_compute"
+    assert cp["critical"]["rank"] == 2
+    assert cp["critical"]["self_s"] == pytest.approx(0.30)
+    assert cp["by_category"]["replica_compute"] == pytest.approx(0.30)
+    # self times decompose exactly: categories sum to the root total
+    assert sum(cp["by_category"].values()) == pytest.approx(0.5)
+
+
+def test_request_paths_sorted_worst_first():
+    recs = _router_stitched_trace("11" * 16)
+    recs += [_span(0, "serve.client", "22" * 16, "f" * 16, None,
+                   0.0, 2.0)]
+    paths = trace_export.request_paths(recs, worst=1)
+    assert len(paths) == 1
+    assert paths[0]["trace_id"] == "22" * 16
+
+
+def test_render_slo_report_text():
+    events_list = [
+        {"kind": "slo_transition", "rank": 1, "t": 10.0,
+         "firing": ["serve_latency"], "cleared": []},
+        {"kind": "watchdog_alert", "rank": 1, "t": 10.0,
+         "rule": "slo_burn_rate", "objective": "serve_latency",
+         "target": 0.99, "burn_5m": 38.0, "burn_1h": 21.5,
+         "burn_6h": 8.2, "page": "fast"},
+    ] + _router_stitched_trace()
+    text = report.render_slo(None, events=events_list, worst=2)
+    assert "rank 1: firing objectives: serve_latency" in text
+    assert "5m=38" in text and "fast page" in text
+    assert "critical hop serve.request.serve.exec" in text
+    assert "replica_compute" in text
+    s = report.slo_summary(events_list)
+    assert s["per_rank"][1]["objectives"]["serve_latency"]["burn"][
+        "5m"] == 38.0
+
+
+def test_cli_slo_flag(tmp_path, capsys, monkeypatch):
+    from dist_keras_tpu.observability.__main__ import main
+
+    d = tmp_path / "obs"
+    monkeypatch.setenv("DK_OBS_DIR", str(d))
+    _reset_all()
+    try:
+        events.emit("train_start", trainer="t")
+    finally:
+        _reset_all()
+    assert main([str(d), "--slo"]) == 0
+    out = capsys.readouterr().out
+    assert "# SLO report" in out
+    assert "no SLO telemetry recorded" in out

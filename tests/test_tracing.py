@@ -484,7 +484,12 @@ def test_chrome_trace_schema_is_perfetto_loadable():
     # cross-rank parent edge -> one flow s/f pair keyed by the child
     flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
     assert {e["ph"] for e in flows} == {"s", "f"}
-    assert all(e["id"] == "c" * 16 for e in flows)
+    handoffs = [e for e in flows if e.get("cat") == "handoff"]
+    assert handoffs and all(e["id"] == "c" * 16 for e in handoffs)
+    # the round-22 critical-path arrows ride their own flow ids
+    cps = [e for e in flows if e.get("cat") == "critical_path"]
+    assert all(e["id"].startswith("cp-") for e in cps)
+    assert {e.get("cat") for e in flows} <= {"handoff", "critical_path"}
     # metadata + the instant
     assert any(e["ph"] == "M" and e["name"] == "process_name"
                for e in doc["traceEvents"])
