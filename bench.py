@@ -709,6 +709,22 @@ def bench_serving(peak=None, timeout_s=300):
         timeout_s=timeout_s)
 
 
+def bench_decode_serving(peak=None, timeout_s=300):
+    """Decode-serving benchmark: tokens/sec, time-to-first-token
+    p50/p99 and KV-page occupancy under paced open-loop generation
+    load against the continuous-batching ``DecodeEngine``
+    (``dist_keras_tpu.serving.bench --decode``), in the same CPU-pinned
+    subprocess harness as ``bench_serving`` and for the same reasons:
+    host-side scheduling is the thing measured, and the row still
+    reports when the device tunnel is wedged.  No reference
+    counterpart for ``vs_baseline`` (the lineage is training-side)."""
+    return _run_cpu_worker(
+        "decode_serving",
+        argv=["-m", "dist_keras_tpu.serving.bench", "--decode",
+              "--rps", "40", "--seconds", "4"],
+        timeout_s=timeout_s)
+
+
 # The router bench worker: the same single-row /predict measured
 # DIRECT against one backend vs ROUTED through a RouterServer over two
 # (the fabric hop's overhead), then a continuous routed stream with one
@@ -1654,6 +1670,8 @@ def main():
         # wedged backend — the round still records real numbers
         for fn, fallback_name in ((bench_serving,
                                    "serving_cpu_offered_load"),
+                                  (bench_decode_serving,
+                                   "decode_serving"),
                                   (bench_router,
                                    "router_overhead"),
                                   (bench_ckpt_manifest,
@@ -1701,7 +1719,8 @@ def main():
     for fn in (bench_adag_mnist_cnn, bench_single_mnist_mlp,
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
-               bench_adag_streamed, bench_serving, bench_router,
+               bench_adag_streamed, bench_serving,
+               bench_decode_serving, bench_router,
                bench_ckpt_manifest,
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,

@@ -47,6 +47,7 @@ KNOWN_THREAD_ROOTS = {
     "serve.reload_watcher": "serving/reload.py:CheckpointWatcher._loop",
     "serve.http": "serving/server.py:ServingServer.serve_forever",
     "serve.http_handler": "~serving/server.py:_Handler.*",
+    "decode.worker": "serving/decode.py:DecodeEngine._worker_loop",
     # serving router tier + autoscaler
     "route.http": "serving/router.py:RouterServer.serve_forever",
     "route.http_handler": "~serving/router.py:_Handler.*",
@@ -81,6 +82,15 @@ LOCK_ORDER = (
      "observability/metrics.py:Gauge._lock"),
     ("serving/engine.py:ServingEngine._cond",
      "observability/metrics.py:Counter._lock"),
+    # the decode engine does the same under its scheduler condition,
+    # and additionally reads/updates the per-replica KV allocator
+    # (strictly inner, never takes the engine lock back)
+    ("serving/decode.py:DecodeEngine._cond",
+     "observability/metrics.py:Gauge._lock"),
+    ("serving/decode.py:DecodeEngine._cond",
+     "observability/metrics.py:Counter._lock"),
+    ("serving/decode.py:DecodeEngine._cond",
+     "serving/kv_cache.py:PagedKVCache._lock"),
     # the async checkpoint writer may emit events between state
     # transitions; the event writer's lock is strictly inner
     ("checkpoint.py:Checkpointer._async_cv",

@@ -141,6 +141,11 @@ KNOWN_EVENTS = (
     "bench_config_end", "bench_config_skipped", "bench_complete",
     # cluster simulator (sim/)
     "sim_scenario_begin", "sim_scenario_end",
+    # continuous-batching decode engine (serving/decode.py,
+    # ops/pallas/decode_attention.py)
+    "decode_admit", "decode_prefill", "decode_step",
+    "decode_complete", "decode_cancel", "decode_error",
+    "decode_drain", "decode_kernel_rejected",
 )
 
 

@@ -313,6 +313,17 @@ KNOWN_METRICS = {
     # cluster simulator (sim/)
     "sim.host_steps": "counter",
     "sim.faults": "counter",
+    # continuous-batching decode engine (serving/decode.py)
+    "decode.admitted": "counter",
+    "decode.completed": "counter",
+    "decode.rejected": "counter",
+    "decode.errors": "counter",
+    "decode.cancelled": "counter",
+    "decode.tokens": "counter",
+    "decode.ttft_s": "histogram",
+    "decode.step_s": "histogram",
+    "decode.active": "gauge",
+    "decode.kv_used_pages": "gauge",
 }
 
 _lock = threading.Lock()

@@ -65,6 +65,12 @@ KNOWN_SLOS = {
     "route_availability": ("router forwards that returned a backend "
                            "answer (bad = route.errors over "
                            "route.requests)"),
+    "generate_ttft": ("decode requests whose first generated token "
+                      "lands under the DK_SLO_TTFT_S threshold "
+                      "(histogram = decode.ttft_s)"),
+    "generate_tokens": ("decode sequences that ran to completion "
+                        "(good = decode.completed, bad = "
+                        "decode.errors + decode.rejected)"),
 }
 
 # (label, window seconds) — shared by burn math, gauges, and the
@@ -388,7 +394,8 @@ def breaching():
 
 def install_defaults():
     """Register the standard serving objectives (idempotent): serving
-    availability + latency, router availability.  A process that never
+    availability + latency, router availability, decode TTFT +
+    sequence completion.  A process that never
     records the underlying metrics keeps the objectives quiet (a
     source reading (0, 0) produces zero burn)."""
     if _default.objectives():
@@ -400,6 +407,12 @@ def install_defaults():
     _default.register(availability(
         "route_availability", total=("route.requests",),
         bad=("route.errors",), target=0.999))
+    _default.register(latency(
+        "generate_ttft", histogram="decode.ttft_s",
+        threshold_s=float(knobs.get("DK_SLO_TTFT_S")), target=0.99))
+    _default.register(availability(
+        "generate_tokens", good=("decode.completed",),
+        bad=("decode.errors", "decode.rejected"), target=0.999))
 
 
 def maybe_evaluate(now=None):

@@ -75,6 +75,11 @@ KNOWN_SPANS = (
     # sending its traceparent header — the gate's client worker does)
     "serve.request", "serve.batch", "serve.queue_wait", "serve.exec",
     "serve.reload", "serve.client",
+    # decode serving (serving/decode.py + serving/server.py): the
+    # /generate handler's live span and the scheduler's retro-stamped
+    # prefill window — together with serve.queue_wait they attribute
+    # time-to-first-token per request
+    "serve.generate", "serve.prefill",
     # router forward hop (serving/router.py — parent of the backend's
     # serve.request via the propagated traceparent header)
     "route.forward",

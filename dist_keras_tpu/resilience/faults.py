@@ -133,6 +133,10 @@ KNOWN_POINTS = (
     # schedules index into this tuple, order is part of the replay
     # contract
     "route.forward", "route.health",
+    # continuous-batching decode engine (serving/decode.py,
+    # serving/kv_cache.py) — appended after the router points for the
+    # same replay-contract reason
+    "decode.admit", "decode.step", "decode.kv_alloc",
 )
 
 

@@ -20,6 +20,13 @@ traffic cutover between two engines sharing devices, and
 :class:`ReplicaAutoscaler` closes the ``QueueDepthGrowth`` alerting
 loop into ``engine.resize`` actuation with hysteresis.
 
+Round 23 adds token-level DECODE serving for the causal transformer:
+:class:`DecodeEngine` continuously batches autoregressive sequences
+(per-sequence futures, prefill/decode phase split, paged KV cache via
+:class:`PagedKVCache` with typed ``kv_exhausted`` admission), the
+server grows ``POST /generate`` (batched or streamed), and the router
+forwards it with the same traceparent stitching.
+
 See the README "Serving" and "Serving fabric" sections for endpoints,
 env knobs, failure matrix and drain semantics; ``examples/serving.py``
 is the runnable demo; ``python -m dist_keras_tpu.serving.bench`` the
@@ -27,7 +34,9 @@ offered-load benchmark.
 """
 
 from dist_keras_tpu.serving.autoscale import ReplicaAutoscaler
+from dist_keras_tpu.serving.decode import DecodeEngine, Generation
 from dist_keras_tpu.serving.engine import Overloaded, ServingEngine
+from dist_keras_tpu.serving.kv_cache import PagedKVCache, PagesExhausted
 from dist_keras_tpu.serving.reload import (
     BlueGreenEngine,
     CheckpointWatcher,
@@ -45,4 +54,6 @@ __all__ = ["ServingEngine", "Overloaded", "CheckpointWatcher",
            "ServingServer", "default_port",
            "RouterServer", "BackendPool", "ForwardError", "NoBackends",
            "BlueGreenEngine", "ReplicaAutoscaler",
-           "default_route_port"]
+           "default_route_port",
+           "DecodeEngine", "Generation", "PagedKVCache",
+           "PagesExhausted"]

@@ -15,7 +15,10 @@ device backend probe times out (the all-null BENCH failure mode).
 
 CLI: ``python -m dist_keras_tpu.serving.bench [--qps N] [--seconds S]``
 prints one JSON record on the last stdout line (the bench driver
-contract).
+contract).  ``--decode`` switches to the decode-serving measurement
+(paced open-loop generation requests against a
+:class:`~.decode.DecodeEngine`): tokens/sec, time-to-first-token
+p50/p99, and KV-page occupancy.
 """
 
 from __future__ import annotations
@@ -124,17 +127,151 @@ def run_serving_benchmark(offered_qps=400.0, duration_s=4.0,
     return record
 
 
+def run_decode_benchmark(offered_rps=40.0, duration_s=4.0, vocab=64,
+                         seq_len=64, d_model=32, n_heads=2, n_layers=2,
+                         prefill_ladder=(8, 16), decode_ladder=(1, 4, 8),
+                         page_size=8, max_new=12, replicas=1,
+                         max_queue=4096, warmup=True, seed=0):
+    """One paced open-loop decode-serving measurement; -> JSON-ready
+    record: tokens/sec sustained, TTFT p50/p99 (the ``generate_ttft``
+    SLO's distribution), sequence latency p50/p99, KV-page occupancy
+    (live + peak), rejections by kind, and the prefill+decode retrace
+    bound.  Offered-load for the same reason as the predict bench: a
+    closed loop would self-throttle to the engine's speed and hide the
+    admission queue entirely."""
+    from dist_keras_tpu.models.transformer import (
+        Transformer,
+        transformer_config,
+    )
+    from dist_keras_tpu.serving.decode import DecodeEngine
+    from dist_keras_tpu.serving.engine import Overloaded
+
+    cfg = transformer_config(input_dim=int(vocab), seq_len=int(seq_len),
+                             d_model=int(d_model), n_heads=int(n_heads),
+                             n_layers=int(n_layers),
+                             n_classes=int(vocab))
+    engine = DecodeEngine(Transformer(cfg), replicas=int(replicas),
+                          prefill_ladder=tuple(prefill_ladder),
+                          decode_ladder=tuple(decode_ladder),
+                          page_size=int(page_size),
+                          max_queue=int(max_queue))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=n).tolist()
+               for n in rng.integers(2, prefill_ladder[-1] + 1,
+                                     size=64)]
+
+    if warmup:
+        # warm every prefill rung and the decode ladder's small rungs
+        # so the measurement window holds zero compiles
+        for rung in engine.prefill_ladder:
+            engine.generate(list(range(1, min(rung, vocab - 1) + 1))
+                            [:rung], max_new_tokens=2, timeout_s=300)
+
+    ttfts = []
+    seq_lats = []
+    tokens_done = [0]
+    lat_lock = threading.Lock()
+    rejected = {"kv_exhausted": 0, "queue_full": 0}
+    submitted = [0]
+
+    def _submit_one(i):
+        t0 = time.monotonic()
+
+        def _done(fut):
+            if fut.exception() is None:
+                doc = fut.result()  # dklint: ignore[unbounded-wait] done-callbacks run only after resolution
+                with lat_lock:
+                    seq_lats.append(time.monotonic() - t0)
+                    if doc["ttft_s"] is not None:
+                        ttfts.append(doc["ttft_s"])
+                    tokens_done[0] += len(doc["generated"])
+        try:
+            gen = engine.submit_generate(prompts[i % len(prompts)],
+                                         max_new_tokens=max_new)
+        except Overloaded as e:
+            rejected[e.reason] = rejected.get(e.reason, 0) + 1
+        else:
+            submitted[0] += 1
+            gen.future.add_done_callback(_done)
+
+    interval = 1.0 / float(offered_rps)
+    t_start = time.monotonic()
+    next_t = t_start
+    occupancy_peak = 0.0
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now - t_start >= duration_s:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        _submit_one(i)
+        if i % 8 == 0:
+            occupancy_peak = max(occupancy_peak,
+                                 engine.kv_stats()["occupancy"])
+        i += 1
+        next_t += interval
+    engine.drain(timeout_s=120)
+    wall = time.monotonic() - t_start
+    stats = engine.stats()
+    kv = stats["kv"]
+    return {
+        "mode": "decode",
+        "offered_rps": float(offered_rps),
+        "duration_s": round(wall, 3),
+        "submitted": submitted[0],
+        "completed": len(seq_lats),
+        "rejected": int(sum(rejected.values())),
+        "rejected_kv": rejected.get("kv_exhausted", 0),
+        "tokens": tokens_done[0],
+        "tokens_per_s": (round(tokens_done[0] / wall, 1)
+                         if wall else None),
+        "ttft_p50_ms": (round(_percentile(ttfts, 50) * 1e3, 3)
+                        if ttfts else None),
+        "ttft_p99_ms": (round(_percentile(ttfts, 99) * 1e3, 3)
+                        if ttfts else None),
+        "seq_p50_ms": (round(_percentile(seq_lats, 50) * 1e3, 3)
+                       if seq_lats else None),
+        "seq_p99_ms": (round(_percentile(seq_lats, 99) * 1e3, 3)
+                       if seq_lats else None),
+        "kv_occupancy_peak": round(max(
+            occupancy_peak, kv["peak_pages"] / kv["num_pages"]
+            if kv["num_pages"] else 0.0), 4),
+        "kv_pages": kv["num_pages"],
+        "replicas": stats["replicas"],
+        "prefill_ladder": stats["prefill_ladder"],
+        "decode_ladder": stats["decode_ladder"],
+        "retrace_count": stats["retrace_count"],
+        "retrace_bound": stats["retrace_bound"],
+        "errors": stats["errors"],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--qps", type=float, default=400.0)
     ap.add_argument("--seconds", type=float, default=4.0)
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--feature-dim", type=int, default=32)
+    ap.add_argument("--decode", action="store_true",
+                    help="measure decode serving (tokens/sec + TTFT) "
+                         "instead of fixed-shape predict")
+    ap.add_argument("--rps", type=float, default=40.0,
+                    help="offered generation requests/sec (--decode)")
+    ap.add_argument("--max-new", type=int, default=12,
+                    help="tokens generated per request (--decode)")
     args = ap.parse_args(argv)
-    record = run_serving_benchmark(offered_qps=args.qps,
-                                   duration_s=args.seconds,
-                                   replicas=args.replicas,
-                                   feature_dim=args.feature_dim)
+    if args.decode:
+        record = run_decode_benchmark(offered_rps=args.rps,
+                                      duration_s=args.seconds,
+                                      replicas=args.replicas,
+                                      max_new=args.max_new)
+    else:
+        record = run_serving_benchmark(offered_qps=args.qps,
+                                       duration_s=args.seconds,
+                                       replicas=args.replicas,
+                                       feature_dim=args.feature_dim)
     print(json.dumps(record))
     return 0
 

@@ -1,0 +1,922 @@
+"""Continuous-batching autoregressive decode engine over paged KV.
+
+``ServingEngine`` packs fixed-shape classifier-style forward passes;
+this engine serves the LLM-shaped workload the rest of the repo was
+built for (causal ``models/transformer.py``, the flash/paged Pallas
+kernels): token-level scheduling with per-sequence futures, no batch
+barrier.
+
+Design points (each mirrors an existing engine contract):
+
+- **Continuous batching.**  A per-replica scheduler thread runs one
+  decode iteration at a time over the replica's ACTIVE sequence set;
+  between iterations it admits queued sequences into free slots and
+  retires finished ones — a short sequence exits early and its slot
+  refills on the very next iteration, never waiting for neighbours
+  (the continuous-batching line of work in PAPERS.md).
+- **Prefill / decode phase split, both ladder-bounded.**  A sequence's
+  prompt runs ONCE through a fixed-shape prefill ladder (padded like
+  the serving batch ladder); every subsequent token runs through a
+  fixed ladder of decode SLOT counts.  Dispatched executable shapes
+  are therefore bounded by ``len(prefill_ladder) + len(decode_ladder)``
+  (x replica devices, inherent) — the same no-retrace contract
+  ``ServingEngine.stats()["retrace_count"]`` verifies, reported the
+  same way.
+- **Paged KV.**  Each replica owns one KV pool array of shape
+  ``(layers, heads, num_pages + 1, page_size, head_dim)`` and a
+  :class:`~dist_keras_tpu.serving.kv_cache.PagedKVCache` allocator.
+  Admission reserves a sequence's WORST-CASE page count up front, so
+  decode never stalls mid-sequence on KV: exhaustion is a typed
+  ``Overloaded(reason="kv_exhausted")`` strictly at the door (rejected,
+  not lost), and completion/cancel/error all reclaim through the one
+  allocator path (zero leaked pages — the chaos tests assert it).
+- **Hot reload never drops a sequence.**  ``submit_generate`` pins the
+  replica's CURRENT params reference into the sequence; a
+  ``set_params`` (CheckpointWatcher promotion, blue/green cutover)
+  swaps the replica reference only — in-flight sequences finish on the
+  params they started with, decode iterations simply group active
+  sequences by params generation (at most a couple in flight).
+- **Typed errors, never hangs.**  The ``decode.admit`` /
+  ``decode.kv_alloc`` / ``decode.step`` fault points cover admission,
+  page reservation and the step dispatch; any failure lands typed on
+  the affected sequences' futures with their pages reclaimed.
+
+Observability: ``decode_*`` events at every seam, ``decode.*``
+registry metrics (TTFT and step-time histograms carry trace
+exemplars), and with tracing on each request's trace gains
+``serve.queue_wait`` + ``serve.prefill`` spans stamped from the
+scheduler thread — time-to-first-token is attributable per request.
+The ``generate_ttft`` / ``generate_tokens`` SLO objectives read these
+surfaces (``observability/slo.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dist_keras_tpu.models.transformer import layer_norm
+from dist_keras_tpu.observability import events, metrics, perf, spans
+from dist_keras_tpu.ops.pallas.decode_attention import (
+    paged_attention_auto,
+)
+from dist_keras_tpu.ops.pallas.flash_attention import (
+    attention_auto,
+    use_pallas,
+)
+from dist_keras_tpu.resilience.faults import fault_point
+from dist_keras_tpu.serving.engine import Overloaded
+from dist_keras_tpu.serving.kv_cache import PagedKVCache, PagesExhausted
+from dist_keras_tpu.utils.serialization import (
+    deserialize_model,
+    serialize_model,
+)
+
+
+class _Sequence:
+    """One admitted generation: host-side state the scheduler owns."""
+
+    __slots__ = ("sid", "tokens", "prompt_len", "max_new", "eos_id",
+                 "future", "on_token", "t", "tw", "ctx", "params",
+                 "pages", "kv_len", "steps", "cancelled", "ttft_s",
+                 "t_first")
+
+    def __init__(self, sid, tokens, max_new, eos_id, on_token, params,
+                 pages):
+        self.sid = sid
+        self.tokens = list(tokens)
+        self.prompt_len = len(tokens)
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.future = Future()
+        self.on_token = on_token
+        self.t = time.monotonic()
+        self.tw = time.time()
+        self.ctx = spans.capture()
+        self.params = params      # pinned: reloads never touch us
+        self.pages = pages
+        self.kv_len = 0           # KV positions written so far
+        self.steps = 0            # decode iterations consumed
+        self.cancelled = False
+        self.ttft_s = None
+        self.t_first = None
+
+    def generated(self):
+        return self.tokens[self.prompt_len:]
+
+    def result_doc(self, finish):
+        return {
+            "tokens": list(self.tokens),
+            "generated": self.generated(),
+            "prompt_len": self.prompt_len,
+            "steps": self.steps,
+            "ttft_s": self.ttft_s,
+            "finish": finish,
+        }
+
+
+class Generation:
+    """Caller-side handle: a future plus a cancel seam (cancel reclaims
+    the sequence's KV pages; the future resolves with
+    ``finish="cancelled"`` and the tokens produced so far)."""
+
+    def __init__(self, engine, seq):
+        self._engine = engine
+        self._seq = seq
+        self.future = seq.future
+
+    def result(self, timeout=None):
+        return self.future.result(timeout=timeout)
+
+    def cancel(self):
+        return self._engine.cancel(self)
+
+    def done(self):
+        return self.future.done()
+
+
+class _DecodeReplica:
+    """One replica: pinned device, params swap point, its KV pool."""
+
+    def __init__(self, index, device, params, cache, kp, vp):
+        self.index = index
+        self.device = device
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
+        self.cache = cache
+        self.kp = kp
+        self.vp = vp
+        self.queue = collections.deque()
+        self.active = []
+        self.retiring = False
+        self.steps = 0
+
+    def put_params(self, params):
+        self.params = (jax.device_put(params, self.device)
+                       if self.device is not None else params)
+
+
+class DecodeEngine:
+    """Continuous-batching decode over the causal Transformer.
+
+    Args:
+      keras_model: a ``models.transformer.Transformer`` (or anything
+        the serialization layer round-trips to one).  Decode needs
+        token in == logit out, so the config must have
+        ``input_dim == n_classes`` (the vocabulary); MoE configs are
+        rejected.
+      replicas: replica count (default: one per visible device).
+      prefill_ladder: ascending fixed PROMPT shapes; a prompt runs
+        padded to the smallest rung that fits (``ValueError`` past the
+        largest — the front end's 400).
+      decode_ladder: ascending fixed SLOT counts for decode steps; the
+        largest rung is the per-replica concurrency cap.
+      page_size: KV positions per page.
+      num_pages: pool pages per replica.  Default sizes the pool so a
+        full slot set of maximum-length sequences fits.
+      max_queue: admission bound on admitted-but-unresolved sequences.
+      max_new_default: ``max_new_tokens`` when a request omits it.
+      eos_id: default stop token (None = length-only stopping).
+      devices: explicit device list (default ``jax.devices()``).
+    """
+
+    def __init__(self, keras_model, replicas=None,
+                 prefill_ladder=(16, 64), decode_ladder=(1, 4, 8),
+                 page_size=8, num_pages=None, max_queue=256,
+                 max_new_default=16, eos_id=None, devices=None):
+        self.serialized = serialize_model(keras_model)
+        model = deserialize_model(self.serialized)
+        cfg = getattr(model, "cfg", None)
+        if cfg is None:
+            raise ValueError(
+                "DecodeEngine needs the causal Transformer model "
+                "contract (a cfg dict); got "
+                f"{type(model).__name__}")
+        if cfg.get("moe_experts", 0):
+            raise ValueError("MoE configs are not decodable here")
+        if cfg["input_dim"] != cfg["n_classes"]:
+            raise ValueError(
+                "causal decode needs token-in == logit-out: "
+                f"input_dim={cfg['input_dim']} != "
+                f"n_classes={cfg['n_classes']}")
+        self.cfg = cfg
+        self.vocab = int(cfg["n_classes"])
+        self.seq_len = int(cfg["seq_len"])
+        self._host_params = model.params
+
+        ladder = sorted(set(int(b) for b in prefill_ladder))
+        if not ladder or ladder[0] < 1 or ladder[-1] > self.seq_len:
+            raise ValueError(
+                f"prefill_ladder {prefill_ladder!r} must hold positive "
+                f"ints <= seq_len ({self.seq_len})")
+        self.prefill_ladder = tuple(ladder)
+        slots = sorted(set(int(b) for b in decode_ladder))
+        if not slots or slots[0] < 1:
+            raise ValueError(
+                f"decode_ladder {decode_ladder!r} must hold positive "
+                "ints")
+        self.decode_ladder = tuple(slots)
+        self.max_slots = slots[-1]
+        self.max_queue = int(max_queue)
+        self.max_new_default = int(max_new_default)
+        self.eos_id = eos_id if eos_id is None else int(eos_id)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = -(-self.seq_len // self.page_size)
+        if num_pages is None:
+            num_pages = self.max_slots * self.max_pages_per_seq
+        self.num_pages = int(num_pages)
+
+        d, h = cfg["d_model"], cfg["n_heads"]
+        self._dh = d // h
+        self._heads = h
+        self._layers = int(cfg["n_layers"])
+        # donation keeps the pool update in place on TPU; CPU jax would
+        # warn-and-copy, so only donate where donation is real
+        donate = (1, 2) if use_pallas() else ()
+        self._prefill_jit = jax.jit(self._prefill_fn,
+                                    donate_argnums=donate)
+        self._decode_jit = jax.jit(self._decode_fn,
+                                   donate_argnums=donate)
+
+        if devices is None:
+            devices = jax.devices()
+        n = int(replicas) if replicas is not None else len(devices)
+        if n < 1:
+            raise ValueError(f"replicas={replicas} must be >= 1")
+        self._devices = list(devices) if devices else []
+        self._next_replica_index = n
+        self._seq_ids = itertools.count()
+        self._replicas = [self._make_replica(i) for i in range(n)]
+
+        self._cond = threading.Condition()
+        self._outstanding = 0
+        self._draining = False
+        self._stopped = False
+        self._drained = threading.Event()
+        self._rr = 0
+        self._shapes = set()      # (phase, rung) dispatched
+        self.reload_count = 0
+
+        # engine-local instruments + the shared process registry (the
+        # same split ServingEngine documents: per-engine truths vs
+        # process-wide aggregates)
+        self._m_ttft = metrics.Histogram("decode.ttft_s")
+        self._m_step = metrics.Histogram("decode.step_s")
+        self._n_admitted = 0
+        self._n_completed = 0
+        self._n_rejected = 0
+        self._n_errors = 0
+        self._n_cancelled = 0
+        self._n_tokens = 0
+        self._reg_admitted = metrics.counter("decode.admitted")
+        self._reg_completed = metrics.counter("decode.completed")
+        self._reg_rejected = metrics.counter("decode.rejected")
+        self._reg_errors = metrics.counter("decode.errors")
+        self._reg_cancelled = metrics.counter("decode.cancelled")
+        self._reg_tokens = metrics.counter("decode.tokens")
+        self._reg_ttft = metrics.histogram("decode.ttft_s")
+        self._reg_step = metrics.histogram("decode.step_s")
+        self._reg_active = metrics.gauge("decode.active")
+        self._reg_kv = metrics.gauge("decode.kv_used_pages")
+        perf.install()  # retrace listener: the ladder bound, verified
+
+        self._workers = [threading.Thread(
+            target=self._worker_loop, args=(rep,), daemon=True,
+            name=f"dk-decode-worker-{rep.index}")
+            for rep in self._replicas]
+        for t in self._workers:
+            t.start()
+
+    # -- model math (jitted once per ladder rung) -----------------------
+    def _make_replica(self, index):
+        devs = self._devices
+        device = devs[index % len(devs)] if devs else None
+        cache = PagedKVCache(self.num_pages, self.page_size)
+        shape = (self._layers, self._heads, self.num_pages + 1,
+                 self.page_size, self._dh)
+        kp = jnp.zeros(shape, jnp.float32)
+        vp = jnp.zeros(shape, jnp.float32)
+        if device is not None:
+            kp = jax.device_put(kp, device)
+            vp = jax.device_put(vp, device)
+        return _DecodeReplica(index, device, self._host_params, cache,
+                              kp, vp)
+
+    def _prefill_fn(self, params, kp, vp, tokens, length, page_idx,
+                    page_off):
+        """One padded prompt -> (first generated token, updated pools).
+
+        ``tokens (T,) int32`` padded to a prefill rung; positions past
+        ``length`` write their K/V to the scratch page (``page_idx``
+        routes them there) and never influence position ``length - 1``
+        under the causal mask."""
+        t = tokens.shape[0]
+        x = jax.nn.one_hot(tokens, self.vocab, dtype=kp.dtype)
+        hs = (x @ params["proj"] + params["pos"][:t])[None]
+        for li, blk in enumerate(params["blocks"]):
+            y = layer_norm(blk["ln1"], hs)
+            q = jnp.einsum("btd,dhk->bthk", y, blk["wq"])
+            k = jnp.einsum("btd,dhk->bthk", y, blk["wk"])
+            v = jnp.einsum("btd,dhk->bthk", y, blk["wv"])
+            # scalar layer + page arrays are non-adjacent advanced
+            # indices: the update's broadcast dims lead -> (T, H, dh)
+            kp = kp.at[li, :, page_idx, page_off, :].set(k[0])
+            vp = vp.at[li, :, page_idx, page_off, :].set(v[0])
+            a = attention_auto(q, k, v, causal=True)
+            hs = hs + jnp.einsum("bthk,hkd->btd", a, blk["wo"])
+            y = layer_norm(blk["ln2"], hs)
+            u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
+            hs = hs + u @ blk["w2"] + blk["b2"]
+        hf = layer_norm(params["ln_f"], hs)[0, length - 1]
+        logits = hf @ params["head"]["kernel"] + params["head"]["bias"]
+        return jnp.argmax(logits).astype(jnp.int32), kp, vp
+
+    def _decode_fn(self, params, kp, vp, tokens, positions, page_tables,
+                   write_page, write_off, lengths):
+        """One token step for a padded slot set -> (next tokens,
+        updated pools).  Padding slots carry ``length == 0`` and write
+        to the scratch page; the paged attention's dead-row guard
+        makes their output exact zeros (then discarded)."""
+        x = (jax.nn.one_hot(tokens, self.vocab, dtype=kp.dtype)
+             @ params["proj"] + params["pos"][positions])
+        hs = x
+        for li, blk in enumerate(params["blocks"]):
+            y = layer_norm(blk["ln1"], hs)
+            q = jnp.einsum("sd,dhk->shk", y, blk["wq"])
+            k = jnp.einsum("sd,dhk->shk", y, blk["wk"])
+            v = jnp.einsum("sd,dhk->shk", y, blk["wv"])
+            kp = kp.at[li, :, write_page, write_off, :].set(k)
+            vp = vp.at[li, :, write_page, write_off, :].set(v)
+            a = paged_attention_auto(q, kp[li], vp[li], page_tables,
+                                     lengths)
+            hs = hs + jnp.einsum("shk,hkd->sd", a, blk["wo"])
+            y = layer_norm(blk["ln2"], hs)
+            u = jax.nn.gelu(y @ blk["w1"] + blk["b1"])
+            hs = hs + u @ blk["w2"] + blk["b2"]
+        hf = layer_norm(params["ln_f"], hs)
+        logits = hf @ params["head"]["kernel"] + params["head"]["bias"]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+    # -- admission ------------------------------------------------------
+    def _rung_for(self, n, ladder):
+        for b in ladder:
+            if n <= b:
+                return b
+        return None
+
+    def _pick_replica(self, needed_pages):
+        """Most free pages wins (KV is the scarce resource), round-robin
+        on ties; retiring replicas are out of rotation.  Caller holds
+        the lock."""
+        live = [r for r in self._replicas if not r.retiring]
+        if not live:
+            return None, 0
+        frees = [r.cache.stats()["free_pages"] for r in live]
+        best = max(frees)
+        order = range(self._rr, self._rr + len(live))
+        for i in order:
+            i %= len(live)
+            if frees[i] == best:
+                self._rr = (i + 1) % len(live)
+                return (live[i] if best >= needed_pages else None), best
+        return None, best  # pragma: no cover - unreachable
+
+    def submit_generate(self, tokens, max_new_tokens=None, eos_id=None,
+                        on_token=None):
+        """Admit one prompt; -> :class:`Generation` whose future
+        resolves to the result doc (tokens, ttft_s, finish reason).
+        Raises :class:`Overloaded` at the door (``queue_full`` /
+        ``kv_exhausted`` / ``draining`` / ``stopped``) and
+        ``ValueError`` for malformed prompts — rejected, never lost."""
+        fault_point("decode.admit")
+        toks = [int(t) for t in tokens]
+        if not toks:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= self.vocab for t in toks):
+            raise ValueError(
+                f"prompt tokens must be in [0, {self.vocab})")
+        max_new = (self.max_new_default if max_new_tokens is None
+                   else int(max_new_tokens))
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens={max_new} must be >= 1")
+        rung = self._rung_for(len(toks), self.prefill_ladder)
+        if rung is None:
+            raise ValueError(
+                f"prompt length {len(toks)} exceeds the prefill "
+                f"ladder (max {self.prefill_ladder[-1]})")
+        total = len(toks) + max_new
+        if total > self.seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the "
+                f"model's seq_len ({self.seq_len})")
+        eos = self.eos_id if eos_id is None else int(eos_id)
+        with self._cond:
+            if self._draining or self._stopped:
+                self._n_rejected += 1
+                self._reg_rejected.inc()
+                raise Overloaded(
+                    "draining" if self._draining else "stopped")
+            if self._outstanding >= self.max_queue:
+                self._n_rejected += 1
+                self._reg_rejected.inc()
+                raise Overloaded("queue_full",
+                                 pending=self._outstanding,
+                                 capacity=self.max_queue)
+            sid = next(self._seq_ids)
+            needed = max(1, -(-total // self.page_size))
+            rep, best_free = self._pick_replica(needed)
+            if rep is None:
+                self._n_rejected += 1
+                self._reg_rejected.inc()
+                raise Overloaded("kv_exhausted", pending=needed,
+                                 capacity=best_free)
+            # the allocator's own fault point (decode.kv_alloc) fires
+            # inside; a raise here admits nothing and leaks nothing
+            pages = rep.cache.alloc(sid, total)
+            seq = _Sequence(sid, toks, max_new, eos, on_token,
+                            rep.params, pages)
+            rep.queue.append(seq)
+            self._outstanding += 1
+            self._n_admitted += 1
+            self._reg_active.set(self._outstanding)
+            self._cond.notify_all()
+        self._reg_admitted.inc()
+        events.emit("decode_admit", sid=sid, prompt_len=len(toks),
+                    max_new=max_new, replica=rep.index,
+                    pages=len(pages))
+        return Generation(self, seq)
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None,
+                 timeout_s=None):
+        """Blocking convenience: submit one prompt, wait for the doc."""
+        return self.submit_generate(
+            tokens, max_new_tokens=max_new_tokens,
+            eos_id=eos_id).result(timeout=timeout_s)
+
+    def cancel(self, generation):
+        """Cancel a generation: reclaim its pages and resolve its
+        future with ``finish="cancelled"`` (tokens so far).  -> True if
+        the cancel landed before completion."""
+        seq = generation._seq
+        dequeued = False
+        with self._cond:
+            if seq.future.done() or seq.cancelled:
+                return False
+            seq.cancelled = True
+            # still queued on some replica? finish it here, never
+            # occupying a slot
+            for rep in self._replicas:
+                if seq in rep.queue:
+                    rep.queue.remove(seq)
+                    self._finish_locked(rep, seq, "cancelled")
+                    dequeued = True
+                    break
+            self._cond.notify_all()
+        if dequeued:
+            events.emit("decode_cancel", sid=seq.sid,
+                        generated=len(seq.generated()))
+            self._resolve(seq, "cancelled")
+        return True  # active: the scheduler retires it next iteration
+
+    # -- scheduler ------------------------------------------------------
+    def _resolve(self, seq, finish, error=None):
+        """Resolve a sequence's future OUTSIDE the lock."""
+        if error is not None:
+            seq.future.set_exception(error)
+        else:
+            seq.future.set_result(seq.result_doc(finish))
+
+    def _finish_locked(self, rep, seq, finish):
+        """Account one sequence's exit (caller holds the lock):
+        reclaim pages, bump counters.  The single reclamation seam for
+        complete/cancel/error — zero leaked pages by construction."""
+        rep.cache.free(seq.sid)
+        self._outstanding -= 1
+        if finish == "error":
+            self._n_errors += 1
+            self._reg_errors.inc()
+        elif finish == "cancelled":
+            self._n_cancelled += 1
+            self._reg_cancelled.inc()
+        elif finish == "stopped":
+            # a close(drain=False) abort is a rejection, not a model
+            # error — rejected-not-lost, same as the door
+            self._n_rejected += 1
+            self._reg_rejected.inc()
+        else:
+            self._n_completed += 1
+            self._reg_completed.inc()
+        self._reg_active.set(self._outstanding)
+        self._reg_kv.set(sum(r.cache.used_pages()
+                             for r in self._replicas))
+        self._cond.notify_all()
+
+    def _emit_token(self, seq, token):
+        seq.tokens.append(int(token))
+        self._n_tokens += 1
+        self._reg_tokens.inc()
+        if seq.on_token is not None:
+            try:
+                seq.on_token(int(token))
+            # dklint: ignore[broad-except] a caller's token callback must never kill the scheduler thread
+            except Exception as e:
+                events.emit("decode_error", sid=seq.sid,
+                            where="on_token", error=type(e).__name__)
+
+    def _sequence_done(self, seq, token):
+        if seq.eos_id is not None and int(token) == seq.eos_id:
+            return "eos"
+        if len(seq.generated()) >= seq.max_new:
+            return "length"
+        return None
+
+    def _prefill(self, rep, seq):
+        """Run one admitted prompt through the prefill ladder; emits
+        the first generated token (TTFT) or fails the sequence typed."""
+        rung = self._rung_for(seq.prompt_len, self.prefill_ladder)
+        toks = np.zeros((rung,), np.int32)
+        toks[:seq.prompt_len] = seq.tokens
+        scratch = rep.cache.scratch_page
+        page_idx = np.full((rung,), scratch, np.int32)
+        ps = self.page_size
+        for t in range(seq.prompt_len):
+            page_idx[t] = seq.pages[t // ps]
+        page_off = (np.arange(rung, dtype=np.int32) % ps)
+        t0 = time.perf_counter()
+        tw0 = time.time()
+        if events.enabled():
+            spans.span_at("serve.queue_wait", seq.ctx, seq.tw, tw0)
+        try:
+            perf.count_dispatch()
+            first, rep.kp, rep.vp = self._prefill_jit(
+                seq.params, rep.kp, rep.vp, jnp.asarray(toks),
+                jnp.int32(seq.prompt_len), jnp.asarray(page_idx),
+                jnp.asarray(page_off))
+            first = int(first)
+        # dklint: ignore[broad-except] a failed prefill lands TYPED on its own future with pages reclaimed
+        except Exception as e:
+            with self._cond:
+                rep.active.remove(seq)
+                self._finish_locked(rep, seq, "error")
+            events.emit("decode_error", sid=seq.sid, where="prefill",
+                        error=type(e).__name__)
+            self._resolve(seq, None, error=e)
+            return
+        dt = time.perf_counter() - t0
+        with self._cond:
+            self._shapes.add(("prefill", rung))
+        seq.kv_len = seq.prompt_len
+        seq.ttft_s = time.monotonic() - seq.t
+        seq.t_first = time.time()
+        ex = ((seq.ctx.trace_id, seq.ctx.span_id)
+              if seq.ctx is not None else None)
+        self._m_ttft.observe(seq.ttft_s, exemplar=ex)
+        self._reg_ttft.observe(seq.ttft_s, exemplar=ex)
+        if events.enabled():
+            spans.span_at("serve.prefill", seq.ctx, tw0, time.time(),
+                          rung=rung, replica=rep.index)
+        events.emit("decode_prefill", sid=seq.sid, rung=rung,
+                    replica=rep.index, duration_s=dt,
+                    ttft_s=seq.ttft_s)
+        self._emit_token(seq, first)
+        finish = self._sequence_done(seq, first)
+        if finish is not None:
+            with self._cond:
+                rep.active.remove(seq)
+                self._finish_locked(rep, seq, finish)
+            events.emit("decode_complete", sid=seq.sid, finish=finish,
+                        generated=len(seq.generated()),
+                        steps=seq.steps)
+            self._resolve(seq, finish)
+
+    def _step_group(self, rep, group):
+        """One decode step for ``group`` (same pinned params), padded
+        to a decode-ladder rung.  A failing step fails exactly this
+        group's sequences, typed, pages reclaimed."""
+        rung = self._rung_for(len(group), self.decode_ladder)
+        scratch = rep.cache.scratch_page
+        ps = self.page_size
+        pmax = self.max_pages_per_seq
+        toks = np.zeros((rung,), np.int32)
+        positions = np.zeros((rung,), np.int32)
+        tables = np.zeros((rung, pmax), np.int32)
+        wpage = np.full((rung,), scratch, np.int32)
+        woff = np.zeros((rung,), np.int32)
+        lengths = np.zeros((rung,), np.int32)
+        for i, seq in enumerate(group):
+            toks[i] = seq.tokens[-1]
+            positions[i] = seq.kv_len
+            tables[i, :len(seq.pages)] = seq.pages
+            wpage[i] = seq.pages[seq.kv_len // ps]
+            woff[i] = seq.kv_len % ps
+            lengths[i] = seq.kv_len + 1
+        t0 = time.perf_counter()
+        try:
+            fault_point("decode.step")
+            perf.count_dispatch()
+            nxt, rep.kp, rep.vp = self._decode_jit(
+                group[0].params, rep.kp, rep.vp, jnp.asarray(toks),
+                jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(wpage), jnp.asarray(woff),
+                jnp.asarray(lengths))
+            nxt = np.asarray(nxt)
+        # dklint: ignore[broad-except] a failed step lands TYPED on every future in the group, pages reclaimed
+        except Exception as e:
+            with self._cond:
+                for seq in group:
+                    rep.active.remove(seq)
+                    self._finish_locked(rep, seq, "error")
+            events.emit("decode_error", where="step", n=len(group),
+                        replica=rep.index, error=type(e).__name__)
+            for seq in group:
+                self._resolve(seq, None, error=e)
+            return
+        dt = time.perf_counter() - t0
+        rep.steps += 1
+        self._m_step.observe(dt)
+        self._reg_step.observe(dt)
+        with self._cond:
+            self._shapes.add(("decode", rung))
+        events.emit("decode_step", replica=rep.index, rung=rung,
+                    n=len(group), duration_s=dt)
+        finished = []
+        for i, seq in enumerate(group):
+            seq.kv_len += 1
+            seq.steps += 1
+            self._emit_token(seq, int(nxt[i]))
+            finish = self._sequence_done(seq, int(nxt[i]))
+            if finish is not None:
+                finished.append((seq, finish))
+        if finished:
+            with self._cond:
+                for seq, finish in finished:
+                    rep.active.remove(seq)
+                    self._finish_locked(rep, seq, finish)
+            for seq, finish in finished:
+                events.emit("decode_complete", sid=seq.sid,
+                            finish=finish,
+                            generated=len(seq.generated()),
+                            steps=seq.steps)
+                self._resolve(seq, finish)
+
+    def _worker_loop(self, rep):
+        while True:
+            admitted = []
+            with self._cond:
+                while (not rep.queue and not rep.active
+                       and not self._stopped and not rep.retiring):
+                    # the scheduler's idle park: deliberately unbounded
+                    # — every admit, cancel and both lifecycle exits
+                    # notify this cond, and the predicate re-checks
+                    # stop/retire on wake
+                    # dklint: ignore[unbounded-wait] idle park; admission and lifecycle exits notify this cond
+                    self._cond.wait()
+                if self._stopped:
+                    break
+                if rep.retiring and not rep.queue and not rep.active:
+                    break
+                # retire cancelled actives, refill free slots — the
+                # continuous-batching seam: between iterations, never
+                # a batch barrier
+                cancelled = [s for s in rep.active if s.cancelled]
+                for seq in cancelled:
+                    rep.active.remove(seq)
+                    self._finish_locked(rep, seq, "cancelled")
+                while rep.queue and len(rep.active) < self.max_slots:
+                    seq = rep.queue.popleft()
+                    if seq.cancelled:
+                        self._finish_locked(rep, seq, "cancelled")
+                        cancelled.append(seq)
+                        continue
+                    rep.active.append(seq)
+                    admitted.append(seq)
+            for seq in cancelled:
+                events.emit("decode_cancel", sid=seq.sid,
+                            generated=len(seq.generated()))
+                self._resolve(seq, "cancelled")
+            for seq in admitted:
+                self._prefill(rep, seq)
+            with self._cond:
+                # group by pinned params generation: a hot reload means
+                # at most a couple of groups until old sequences drain
+                groups = {}
+                for seq in rep.active:
+                    groups.setdefault(id(seq.params), []).append(seq)
+                work = list(groups.values())
+            for group in work:
+                self._step_group(rep, group)
+
+    # -- hot reload -----------------------------------------------------
+    def set_params(self, state, step=None):
+        """Swap every replica's params reference.  In-flight sequences
+        keep their pinned params (finish on what they started with);
+        sequences admitted after this call see the new ones — zero
+        dropped mid-decode sequences, the blue/green contract."""
+        params = (state["params"]
+                  if isinstance(state, dict) and "params" in state
+                  else state)
+        for rep in self._replicas:
+            rep.put_params(params)
+        self._host_params = params
+        self.reload_count += 1
+        metrics.counter("serve.reloads").inc()
+        events.emit("serve_reload", step=step, role="decode",
+                    replicas=len(self._replicas))
+
+    # -- elastic replica set --------------------------------------------
+    def resize(self, n):
+        """Grow or shrink the replica set (the autoscaler's actuation
+        seam).  Grow: fresh replicas with fresh KV pools on the
+        construction device list.  Shrink: retired replicas stop
+        admitting, finish every sequence they hold, then exit (nothing
+        admitted is ever dropped).  -> the new live replica count."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"resize({n}): must keep >= 1 replica")
+        started = []
+        with self._cond:
+            if self._stopped or self._draining:
+                raise Overloaded(
+                    "stopped" if self._stopped else "draining")
+            live = [r for r in self._replicas if not r.retiring]
+            cur = len(live)
+            if n < cur:
+                for rep in live[n:]:
+                    rep.retiring = True
+                self._rr = 0
+                self._cond.notify_all()
+            elif n > cur:
+                for _ in range(n - cur):
+                    idx = self._next_replica_index
+                    self._next_replica_index += 1
+                    rep = self._make_replica(idx)
+                    self._replicas.append(rep)
+                    t = threading.Thread(
+                        target=self._worker_loop, args=(rep,),
+                        daemon=True, name=f"dk-decode-worker-{idx}")
+                    self._workers.append(t)
+                    started.append(t)
+        for t in started:
+            t.start()
+        return n
+
+    # -- lifecycle ------------------------------------------------------
+    def drain(self, timeout_s=None):
+        """Stop admission (typed rejection), let every admitted
+        sequence decode to completion, then stop the schedulers.
+        Nothing admitted is ever dropped.  -> delivery counts."""
+        t0 = time.perf_counter()
+        with self._cond:
+            self._draining = True
+            backlog = self._outstanding
+            self._cond.notify_all()
+        events.emit("serve_drain_begin", backlog=backlog,
+                    role="decode")
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._cond:
+            while self._outstanding:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"drain: {self._outstanding} sequences still "
+                        f"in flight after {timeout_s}s")
+                self._cond.wait(remaining)
+        self._shutdown_threads()
+        out = {"delivered": self._n_completed,
+               "errored": self._n_errors,
+               "rejected": self._n_rejected,
+               "cancelled": self._n_cancelled,
+               "duration_s": time.perf_counter() - t0}
+        events.emit("decode_drain", **out)
+        return out
+
+    def _shutdown_threads(self):
+        with self._cond:
+            first = not self._stopped
+            self._stopped = True
+            self._cond.notify_all()
+        if not first:
+            self._drained.wait(timeout=10)
+            return
+        for t in self._workers:
+            if t is not threading.current_thread():
+                t.join(timeout=10)
+        self._drained.set()
+
+    def close(self, drain=True, timeout_s=None):
+        """Stop the engine.  ``drain=True`` finishes the backlog;
+        ``drain=False`` fails unresolved sequences with a typed
+        :class:`Overloaded` and reclaims their pages (never a silent
+        drop, never a leaked page)."""
+        if self._stopped:
+            return
+        if drain:
+            self.drain(timeout_s=timeout_s)
+            return
+        with self._cond:
+            self._draining = True
+        self._shutdown_threads()
+        orphans = []
+        with self._cond:
+            for rep in self._replicas:
+                for seq in list(rep.queue) + list(rep.active):
+                    orphans.append((rep, seq))
+                rep.queue.clear()
+                del rep.active[:]
+            for rep, seq in orphans:
+                self._finish_locked(rep, seq, "stopped")
+        for _, seq in orphans:
+            self._resolve(seq, None, error=Overloaded("stopped"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def running(self):
+        return not self._stopped
+
+    def kv_stats(self):
+        """Aggregate + per-replica page-pool accounting."""
+        per = [r.cache.stats() for r in self._replicas
+               if not r.retiring]
+        total = sum(p["num_pages"] for p in per)
+        used = sum(p["used_pages"] for p in per)
+        return {
+            "num_pages": total,
+            "used_pages": used,
+            "peak_pages": sum(p["peak_pages"] for p in per),
+            "occupancy": (used / total) if total else 0.0,
+            "sequences": sum(p["sequences"] for p in per),
+            "replicas": per,
+        }
+
+    def assert_no_leaks(self):
+        """Every replica's allocator balances and, when idle, holds
+        zero pages — the chaos sweep / gate invariant."""
+        for rep in self._replicas:
+            rep.cache.assert_balanced()
+        with self._cond:
+            idle = self._outstanding == 0
+        if idle:
+            for rep in self._replicas:
+                used = rep.cache.used_pages()
+                if used:
+                    raise AssertionError(
+                        f"replica {rep.index} leaked {used} KV pages "
+                        "with no sequence outstanding")
+
+    def stats(self):
+        """JSON-ready engine counters — the ``/metricsz`` payload core
+        (same retrace contract as ``ServingEngine.stats``)."""
+        with self._cond:
+            queued = sum(len(r.queue) for r in self._replicas)
+            active = sum(len(r.active) for r in self._replicas)
+            outstanding = self._outstanding
+            shapes = sorted(self._shapes)
+            live = sum(1 for r in self._replicas if not r.retiring)
+        return {
+            "replicas": live,
+            "prefill_ladder": list(self.prefill_ladder),
+            "decode_ladder": list(self.decode_ladder),
+            "page_size": self.page_size,
+            "queued": queued,
+            "active": active,
+            "pending": queued,
+            "outstanding": outstanding,
+            "admitted": self._n_admitted,
+            "completed": self._n_completed,
+            "rejected": self._n_rejected,
+            "errors": self._n_errors,
+            "cancelled": self._n_cancelled,
+            "tokens": self._n_tokens,
+            "reloads": self.reload_count,
+            "shapes_dispatched": shapes,
+            # the no-retrace bound: prefill rungs + decode rungs ever
+            # dispatched (executables are shapes x replica devices on
+            # top, both factors fixed)
+            "retrace_count": len(shapes),
+            "retrace_bound": (len(self.prefill_ladder)
+                              + len(self.decode_ladder)),
+            "draining": self._draining,
+            "kv": self.kv_stats(),
+            "ttft_s": self._m_ttft.summary(),
+            "step_s": self._m_step.summary(),
+        }

@@ -83,9 +83,11 @@ class Overloaded(RuntimeError):
     """Typed admission rejection — queue full, draining, or stopped.
 
     ``reason`` is one of ``"queue_full"`` / ``"draining"`` /
-    ``"stopped"``; ``pending`` / ``capacity`` let a front end answer
-    503 with real numbers.  Requests already admitted are unaffected:
-    rejection is strictly at the door, never a drop.
+    ``"stopped"`` — or ``"kv_exhausted"`` from the decode engine, whose
+    door additionally reserves worst-case KV pages per sequence;
+    ``pending`` / ``capacity`` let a front end answer 503 with real
+    numbers.  Requests already admitted are unaffected: rejection is
+    strictly at the door, never a drop.
     """
 
     def __init__(self, reason, pending=None, capacity=None):

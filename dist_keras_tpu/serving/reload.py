@@ -87,8 +87,9 @@ class BlueGreenEngine:
     in-between, and a bad load never touches the serving color.
 
     The class quacks like a single engine everywhere the serving stack
-    cares (``submit`` / ``predict`` / ``set_params`` / ``resize`` /
-    ``stats`` / ``drain`` / ``close`` / ``draining`` / ``running``),
+    cares (``submit`` / ``predict`` / ``submit_generate`` /
+    ``generate`` / ``set_params`` / ``resize`` / ``stats`` / ``drain``
+    / ``close`` / ``draining`` / ``running``),
     so :class:`ServingServer`, :class:`CheckpointWatcher`, and the
     autoscaler compose with it unchanged.  Each cutover emits
     ``route_cutover`` + the ``route.cutovers`` counter.
@@ -121,6 +122,23 @@ class BlueGreenEngine:
     def predict(self, rows, timeout_s=None):
         return self._engines[self._active_idx].predict(
             rows, timeout_s=timeout_s)
+
+    def submit_generate(self, tokens, max_new_tokens=None, eos_id=None,
+                        on_token=None):
+        # decode passthrough (DecodeEngine colors): same atomic-read
+        # race rule — a generation lands WHOLE in one color; after a
+        # cutover the old color finishes every sequence it admitted on
+        # the params they were admitted under (the engine pins them),
+        # so a mid-decode rollout never drops a sequence
+        return self._engines[self._active_idx].submit_generate(
+            tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            on_token=on_token)
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None,
+                 timeout_s=None):
+        return self.submit_generate(
+            tokens, max_new_tokens=max_new_tokens,
+            eos_id=eos_id).result(timeout=timeout_s)
 
     # -- rollout --------------------------------------------------------
     def set_params(self, state, step=None):

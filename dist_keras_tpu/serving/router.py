@@ -395,7 +395,8 @@ class _Handler(BaseHTTPRequestHandler):
         # would poison the keep-alive connection's framing
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
-        if self.path.split("?")[0] != "/predict":
+        path = self.path.split("?")[0]
+        if path not in ("/predict", "/generate"):
             self._reply(404, {"error": "not_found", "path": self.path})
             return
         if srv.draining:
@@ -410,7 +411,8 @@ class _Handler(BaseHTTPRequestHandler):
         with spans.resume(ctx):
             with spans.span("route.forward", n_bytes=len(body)):
                 self._trace_header = spans.traceparent()
-                code, payload, ctype, retry_after = srv.forward(body)
+                code, payload, ctype, retry_after = srv.forward(
+                    body, path=path)
         self._reply_bytes(code, payload, ctype, retry_after=retry_after)
 
 
@@ -462,13 +464,18 @@ class RouterServer(ThreadingHTTPServer):
         return self.server_address[:2]
 
     # -- forwarding -----------------------------------------------------
-    def forward(self, body):
-        """Place one ``/predict`` body on a live backend; -> (status,
-        body bytes, content type, retry_after).  Connect failures and
-        backend 503s burn the attempt and move to a SIBLING (excluded
-        set) through the ``route.forward`` retry surface — at most one
-        re-send, idempotent because predict is stateless.  Exhaustion
-        and an empty pool are typed 503 + Retry-After."""
+    def forward(self, body, path="/predict"):
+        """Place one ``/predict`` or ``/generate`` body on a live
+        backend; -> (status, body bytes, content type, retry_after).
+        Connect failures and backend 503s burn the attempt and move to
+        a SIBLING (excluded set) through the ``route.forward`` retry
+        surface — at most one re-send, idempotent because an admission
+        either lands whole or is typed-rejected at the backend's door
+        (``/generate`` included: a 503 ``kv_exhausted`` moves the
+        request to a sibling with free pages).  Exhaustion and an empty
+        pool are typed 503 + Retry-After.  The router forwards
+        ``/generate`` BATCHED — token streaming is a direct-to-host
+        feature (the hop buffers a chunked response whole)."""
         t0 = _world.monotonic()
         excluded = set()
 
@@ -483,7 +490,7 @@ class RouterServer(ThreadingHTTPServer):
             if tp is not None:
                 headers["traceparent"] = tp
             req = urllib.request.Request(
-                f"http://{addr}/predict", data=body, method="POST",
+                f"http://{addr}{path}", data=body, method="POST",
                 headers=headers)
             try:
                 with urllib.request.urlopen(

@@ -15,6 +15,14 @@ Endpoints:
   error type, a response outliving ``request_timeout_s`` -> **504**,
   bad JSON -> **400**.  Rejected requests are REJECTED AT THE DOOR —
   admitted ones are always answered (the engine's no-drop contract).
+- ``POST /generate`` — autoregressive decode against a
+  :class:`~.decode.DecodeEngine` backend: body ``{"tokens": [...],
+  "max_new_tokens": N, "eos_id": E, "stream": bool}``.  Batched replies
+  return the engine's result doc (tokens, TTFT, finish reason);
+  ``stream: true`` answers chunked NDJSON, one line per token as it
+  lands.  The same typed mapping applies (503 incl.
+  ``kv_exhausted``, 400, 504 — a timed-out generation is cancelled so
+  its KV pages reclaim); a fixed-shape predict backend answers **501**.
 - ``GET /healthz`` — **200** ``{"status": "serving"}`` while accepting;
   **503** ``{"status": "draining"}`` once drain began, so a load
   balancer stops routing here during the grace window.
@@ -152,7 +160,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         srv = self.server
         self._trace_header = None
-        if self.path.split("?")[0] != "/predict":
+        path = self.path.split("?")[0]
+        if path == "/generate":
+            self._handle_generate(srv)
+            return
+        if path != "/predict":
             self._reply(404, {"error": "not_found", "path": self.path})
             return
         try:
@@ -212,6 +224,145 @@ class _Handler(BaseHTTPRequestHandler):
         return 200, {
             "predictions": [np.asarray(p).tolist() for p in preds],
             "n": len(preds)}, None
+
+    # -- decode serving (POST /generate) -------------------------------
+    def _handle_generate(self, srv):
+        """``POST /generate`` — body ``{"tokens": [...],
+        "max_new_tokens": N, "eos_id": E, "stream": bool}`` (or a bare
+        token list).  Batched replies carry the engine's result doc;
+        ``stream: true`` answers chunked NDJSON, one ``{"token": t}``
+        line per generated token as it lands plus a final ``{"done":
+        true, ...}`` summary line.  Same typed mapping as /predict:
+        Overloaded -> 503 + Retry-After (incl. ``kv_exhausted``),
+        malformed prompt -> 400, deadline -> 504 (the generation is
+        CANCELLED so its slot and KV pages free immediately)."""
+        if not hasattr(srv.engine, "submit_generate"):
+            self._reply(501, {
+                "error": "not_implemented",
+                "detail": "this backend serves a fixed-shape predict "
+                          "engine; /generate needs a DecodeEngine"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n).decode("utf-8"))
+            if isinstance(doc, list):
+                doc = {"tokens": doc}
+            tokens = [int(t) for t in doc["tokens"]]
+            max_new = doc.get("max_new_tokens")
+            eos_id = doc.get("eos_id")
+            stream = bool(doc.get("stream", False))
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad_request",
+                              "detail": str(e)[:200]})
+            return
+        ctx = spans.parse_traceparent(self.headers.get("traceparent"))
+        with spans.resume(ctx):
+            with spans.span("serve.generate", prompt_len=len(tokens),
+                            stream=stream):
+                self._trace_header = spans.traceparent()
+                if stream:
+                    self._generate_stream(srv, tokens, max_new, eos_id)
+                else:
+                    code, payload, retry = self._generate(
+                        srv, tokens, max_new, eos_id)
+                    self._reply(code, payload, retry_after=retry)
+
+    def _admit_generate(self, srv, tokens, max_new, eos_id,
+                        on_token=None):
+        """-> (generation, None) or (None, (status, payload,
+        retry_after)) with the engine's typed failure mapping."""
+        try:
+            gen = srv.engine.submit_generate(
+                tokens, max_new_tokens=max_new, eos_id=eos_id,
+                on_token=on_token)
+        except Overloaded as e:
+            return None, (503, {"error": "overloaded",
+                                "reason": e.reason,
+                                "pending": e.pending,
+                                "capacity": e.capacity}, 1)
+        except ValueError as e:  # malformed prompt: the CALLER's bug
+            return None, (400, {"error": "bad_request",
+                                "detail": str(e)[:200]}, None)
+        # dklint: ignore[broad-except] admission error maps to a typed HTTP status, never a dead handler
+        except Exception as e:  # typed admission error (fault, ...)
+            return None, (500, {"error": type(e).__name__,
+                                "detail": str(e)[:200]}, None)
+        return gen, None
+
+    def _generate(self, srv, tokens, max_new, eos_id):
+        gen, err = self._admit_generate(srv, tokens, max_new, eos_id)
+        if err is not None:
+            return err
+        try:
+            doc = gen.result(timeout=srv.request_timeout_s)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # reclaim the slot and its KV pages NOW — a deadline miss
+            # must not keep burning decode iterations
+            gen.cancel()
+            return 504, {"error": "timeout",
+                         "timeout_s": srv.request_timeout_s}, None
+        # dklint: ignore[broad-except] decode error maps to a typed HTTP 500 naming the type
+        except Exception as e:  # typed decode error (fault, ...)
+            return 500, {"error": type(e).__name__,
+                         "detail": str(e)[:200]}, None
+        return 200, doc, None
+
+    def _generate_stream(self, srv, tokens, max_new, eos_id):
+        """Chunked-NDJSON streaming: tokens flush as the scheduler
+        emits them (the engine's ``on_token`` callback feeds a local
+        queue this handler drains)."""
+        import queue as _queue
+
+        q = _queue.Queue()
+        gen, err = self._admit_generate(srv, tokens, max_new, eos_id,
+                                        on_token=q.put)
+        if err is not None:
+            code, payload, retry = err
+            self._reply(code, payload, retry_after=retry)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        if self._trace_header is not None:
+            self.send_header("traceparent", self._trace_header)
+        self.end_headers()
+
+        def chunk(obj):
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        deadline = time.monotonic() + srv.request_timeout_s
+        i = 0
+        try:
+            while True:
+                try:
+                    chunk({"i": i, "token": q.get(timeout=0.05)})
+                    i += 1
+                except _queue.Empty:
+                    if gen.done():
+                        break
+                    if time.monotonic() > deadline:
+                        gen.cancel()  # resolves as finish=cancelled
+                        deadline = float("inf")
+            # the future resolves AFTER its last on_token fired (same
+            # scheduler thread), so a drained queue here is complete
+            while not q.empty():
+                chunk({"i": i, "token": q.get()})
+                i += 1
+            try:
+                doc = gen.result(timeout=0)
+                chunk({"done": True, "finish": doc["finish"],
+                       "prompt_len": doc["prompt_len"],
+                       "steps": doc["steps"], "ttft_s": doc["ttft_s"]})
+            # dklint: ignore[broad-except] a failed generation ends the stream with a typed error line
+            except Exception as e:
+                chunk({"done": True, "error": type(e).__name__,
+                       "detail": str(e)[:200]})
+            self.wfile.write(b"0\r\n\r\n")
+        except (ConnectionError, BrokenPipeError):
+            # client went away mid-stream: stop decoding for it
+            gen.cancel()
 
 
 class ServingServer(ThreadingHTTPServer):

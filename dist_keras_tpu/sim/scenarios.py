@@ -46,6 +46,7 @@ verdict — the CLI and the gate only relay it.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import shutil
@@ -750,6 +751,237 @@ def router_failover(world, hosts=None, workdir=None):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def router_decode_spike(world, hosts=None, workdir=None):
+    """Router failover under a spike of LONG-RUNNING decode sequences
+    (the ROADMAP-flagged scenario): the :class:`BackendPool` policy
+    core on sim time over modeled decode backends, each owning a real
+    :class:`~dist_keras_tpu.serving.kv_cache.PagedKVCache` and a fixed
+    slot set.  Sequences hold pages for their whole multi-tick
+    lifetime, so the spike exhausts KV and the router's
+    sibling-on-503 policy spreads ``kv_exhausted`` rejections across
+    hosts; one backend dies mid-spike with sequences in flight.
+    Invariants: eviction inside the stale window, re-admission after
+    heal, zero placements on an evicted backend, every admitted
+    sequence either completes or is attributed to the host death
+    (nothing silently dropped), and every surviving backend's page
+    accounting balances to zero at the end."""
+    from dist_keras_tpu.serving.kv_cache import (
+        PagedKVCache,
+        PagesExhausted,
+    )
+    from dist_keras_tpu.serving.router import BackendPool
+
+    hosts = 6 if hosts is None else max(3, int(hosts))
+    rng = world.rng
+    own = workdir is None
+    if own:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="dk-sim-decode-")
+    try:
+        coord = os.path.join(workdir, "coord")
+        hb = os.path.join(coord, "hb")
+        os.makedirs(hb, exist_ok=True)
+        addrs = [f"sim{r}:9000" for r in range(hosts)]
+        probe_s, stale_s = 0.5, 2.0
+        pool = BackendPool(addrs, fail_threshold=3, stale_s=stale_s,
+                           readmit_checks=2, coord_dir=coord,
+                           world_size=hosts)
+        page_size, num_pages, slots = 4, 24, 6
+
+        def fresh_backend(rank):
+            return {"up": True, "rank": rank,
+                    "cache": PagedKVCache(num_pages, page_size),
+                    "active": {}}  # seq id -> remaining decode ticks
+
+        backends = {a: fresh_backend(r) for r, a in enumerate(addrs)}
+        seq_ids = itertools.count()
+
+        def _stamp(rank):
+            path = os.path.join(hb, f"rank_{rank}")
+            with open(path, "w") as f:
+                f.write(repr(world.time()))
+            t = world.time()
+            os.utime(path, (t, t))
+
+        for r in range(hosts):
+            _stamp(r)
+
+        def beat():
+            for b in backends.values():
+                if b["up"]:
+                    _stamp(b["rank"])
+            world.call_later(0.5, beat)
+
+        world.call_later(0.5, beat)
+
+        victim = addrs[rng.randrange(hosts)]
+        t_kill, t_heal, t_end = 4.0, 12.0, 20.0
+        tick = 0.1
+        placed = completed = rejected = 0
+        kv_rejections = lost_on_kill = 0
+        picked_dead_after_evict = 0
+        kill_at = evict_after = readmit_at = None
+        next_probe = 0.0
+
+        def admit(b):
+            """One decode admission against a modeled backend — the
+            DecodeEngine door: slots then worst-case page reservation,
+            typed refusal otherwise (the router sees a 503 and moves
+            to a sibling, exactly ``forward``'s policy)."""
+            if len(b["active"]) >= slots:
+                raise PagesExhausted(0, 0, num_pages)
+            plen = rng.randrange(2, 9)
+            max_new = rng.randrange(10, 31)
+            sid = next(seq_ids)
+            b["cache"].alloc(sid, plen + max_new)  # may raise
+            b["active"][sid] = max_new
+            return sid
+
+        while world.elapsed < t_end:
+            now = world.elapsed
+            if kill_at is None and now >= t_kill:
+                b = backends[victim]
+                b["up"] = False
+                # the host died with sequences in flight: they are
+                # LOST TO THE HOST (attributed, not silent) and its
+                # restart comes back with a fresh pool
+                lost_on_kill = len(b["active"])
+                b["active"] = {}
+                b["cache"] = PagedKVCache(num_pages, page_size)
+                kill_at = now
+                world.record("kill", backend=victim,
+                             lost=lost_on_kill)
+            if (kill_at is not None and now >= t_heal
+                    and not backends[victim]["up"]):
+                backends[victim]["up"] = True
+                world.record("heal", backend=victim)
+            if now >= next_probe:
+                for a, b in backends.items():
+                    if b["up"]:
+                        pool.note_probe(a, True,
+                                        depth=len(b["active"]))
+                    else:
+                        pool.note_probe(a, False)
+                pool.sweep()
+                next_probe = now + probe_s
+                snap = {s["addr"]: s for s in pool.snapshot()}
+                if (evict_after is None and kill_at is not None
+                        and not snap[victim]["live"]):
+                    evict_after = now - kill_at
+                    world.record(
+                        "evicted", backend=victim,
+                        reason=snap[victim]["evicted_reason"],
+                        after_s=round(evict_after, 9))
+                if (evict_after is not None and readmit_at is None
+                        and now >= t_heal and snap[victim]["live"]):
+                    readmit_at = now
+                    world.record("readmitted", backend=victim,
+                                 at_s=round(now, 9))
+            # offered load: long-running generations, spiking over the
+            # kill instant — each holds pages for its whole lifetime
+            spike = 2.0 <= now <= 9.0
+            for _ in range(rng.randrange(3, 6) if spike
+                           else rng.randrange(0, 2)):
+                excluded = set()
+                for _attempt in range(2):  # router: 1 sibling retry
+                    a = pool.pick(exclude=excluded)
+                    if a is None:
+                        rejected += 1
+                        break
+                    if evict_after is not None and a == victim \
+                            and not backends[a]["up"]:
+                        picked_dead_after_evict += 1
+                    b = backends[a]
+                    if b["up"]:
+                        try:
+                            admit(b)
+                        except PagesExhausted:
+                            # backend answered a typed 503
+                            # kv_exhausted: reachable, but this
+                            # REQUEST moves to a sibling
+                            kv_rejections += 1
+                            pool.note_forward(a, True)
+                            excluded.add(a)
+                            continue
+                        pool.note_forward(a, True)
+                        placed += 1
+                        break
+                    pool.note_forward(a, False)
+                    excluded.add(a)
+                else:
+                    rejected += 1
+            # continuous batching: every active sequence on a live
+            # backend decodes one token per tick; completions free
+            # their pages the same tick
+            for b in backends.values():
+                if not b["up"]:
+                    continue
+                done = []
+                for sid in b["active"]:
+                    b["active"][sid] -= 1
+                    if b["active"][sid] <= 0:
+                        done.append(sid)
+                for sid in done:
+                    del b["active"][sid]
+                    b["cache"].free(sid)
+                    completed += 1
+            world.advance(tick)
+
+        # drain: every still-active sequence decodes to completion
+        for _ in range(400):
+            if not any(b["active"] for b in backends.values()
+                       if b["up"]):
+                break
+            for b in backends.values():
+                if not b["up"]:
+                    continue
+                done = []
+                for sid in b["active"]:
+                    b["active"][sid] -= 1
+                    if b["active"][sid] <= 0:
+                        done.append(sid)
+                for sid in done:
+                    del b["active"][sid]
+                    b["cache"].free(sid)
+                    completed += 1
+            world.advance(tick)
+
+        _require(evict_after is not None,
+                 "the killed backend was never evicted")
+        _require(evict_after <= stale_s + 2 * probe_s + 1e-9,
+                 f"eviction took {evict_after:.2f}s — outside the "
+                 f"stale window {stale_s}s + probe slack")
+        _require(readmit_at is not None,
+                 "the healed backend was never re-admitted")
+        _require(picked_dead_after_evict == 0,
+                 f"{picked_dead_after_evict} requests were routed to "
+                 "an evicted backend")
+        _require(completed + lost_on_kill == placed,
+                 f"silently dropped sequences: completed {completed} "
+                 f"+ lost {lost_on_kill} != placed {placed}")
+        _require(kv_rejections > 0,
+                 "the spike never exhausted a KV pool — the scenario "
+                 "is not exercising paged admission")
+        for a, b in backends.items():
+            b["cache"].assert_balanced()
+            _require(b["cache"].used_pages() == 0,
+                     f"{a} leaked {b['cache'].used_pages()} KV pages")
+        return {"hosts": hosts, "victim": victim,
+                "evict_after_s": round(evict_after, 6),
+                "readmit_at_s": round(readmit_at, 6),
+                "placed": placed, "completed": completed,
+                "lost_on_kill": lost_on_kill,
+                "rejected": rejected,
+                "kv_rejections": kv_rejections,
+                "evictions": pool.evictions,
+                "readmissions": pool.readmissions,
+                "sleeps": world.sleeps}
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def slo_burn(world, hosts=None, workdir=None):
     """The SLO plane's multi-window burn-rate math driven on SIM time:
     seeded modeled serving traffic with a mid-run error window.  The
@@ -852,5 +1084,6 @@ SCENARIOS = {
     "relaunch_waves": relaunch_waves,
     "gc_race": gc_race,
     "router_failover": router_failover,
+    "router_decode_spike": router_decode_spike,
     "slo_burn": slo_burn,
 }

@@ -225,6 +225,10 @@ _register("DK_SLO_LATENCY_S", 0.5, float, kind="seconds",
               "slower than this is a bad event for the "
               "`serve_latency` objective (also the default "
               "slow-request bar for tail-based trace retention)")
+_register("DK_SLO_TTFT_S", 1.0, float, kind="seconds",
+          doc="time-to-first-token threshold: a decode request whose "
+              "first generated token lands slower than this is a bad "
+              "event for the `generate_ttft` objective")
 _register("DK_TRACE_SAMPLE", 0.0, float,
           kind="fraction",
           doc="head-sampling rate in [0, 1] for tail-based retention: "
@@ -300,6 +304,17 @@ _register("DK_PS_COMPRESS", None, str,
 _register("DK_SERVE_PORT", None, int, kind="port",
           doc="the port a launched serving job binds (exported per "
               "host by `launch.Job(serve_port=...)`)")
+
+# decode serving (serving/decode.py)
+_register("DK_DECODE_KERNEL", False, _parse_bool, kind="bool",
+          doc="`1` routes the decode engine's paged attention through "
+              "the single-query Pallas kernel — but only after a "
+              "cached per-(shape, compiler) `selfcheck()` parity run "
+              "against the pure-jax paged reference passes EXACT in "
+              "this process; mismatch or an unverifiable backend "
+              "falls back to the reference with a "
+              "`decode_kernel_rejected` event, never silent "
+              "corruption")
 
 # serving router tier (serving/router.py)
 _register("DK_ROUTE_PORT", None, int, kind="port",

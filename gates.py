@@ -1076,6 +1076,213 @@ finish(resizes=a.resizes, replicas=eng.stats()["replicas"])
 """
 
 
+# The decode gate's worker (round 23, three modes, one script):
+#
+# - "load": the offered-load decode benchmark — mixed prefill+decode
+#   sustained generation; every ADMITTED sequence delivers (rejections
+#   are typed kv/queue backpressure, not drops), TTFT p99 bounded,
+#   retraces within the prefill+decode ladder bound, zero errors.
+# - "bluegreen": a BlueGreenEngine over two DecodeEngine colors under
+#   continuous generation load across two set_params cutovers — zero
+#   dropped sequences (the old color finishes every sequence it
+#   admitted on its pinned params), old color fully drained.
+# - "chaos": targeted decode.admit / decode.kv_alloc / decode.step
+#   faults plus a seeded randomized sweep — every failure typed
+#   (FaultInjected | Overloaded), the engine keeps serving afterwards,
+#   and the paged KV allocator balances to ZERO leaked pages.
+_DECODE_WORKER = r"""
+import os, sys, json, time, threading
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %REPO%)
+import numpy as np
+from dist_keras_tpu.models.transformer import (
+    Transformer, transformer_config)
+from dist_keras_tpu.resilience import faults
+from dist_keras_tpu.resilience.faults import FaultInjected
+from dist_keras_tpu.serving import (
+    BlueGreenEngine, DecodeEngine, Overloaded)
+from dist_keras_tpu.serving.bench import run_decode_benchmark
+
+mode, work = sys.argv[1], sys.argv[2]
+failures = []
+
+def check(cond, msg):
+    if not cond:
+        failures.append(msg)
+
+def finish(**extra):
+    print("DECODE_RESULT " + json.dumps(
+        {"ok": not failures, "failures": failures, **extra}),
+        flush=True)
+    sys.exit(0 if not failures else 1)
+
+VOCAB = 32
+CFG = transformer_config(input_dim=VOCAB, seq_len=48, d_model=16,
+                         n_heads=2, n_layers=2, n_classes=VOCAB)
+rng = np.random.default_rng(0)
+prompts = [rng.integers(1, VOCAB, size=int(n)).tolist()
+           for n in rng.integers(2, 9, size=32)]
+
+if mode == "load":
+    rec = run_decode_benchmark(offered_rps=30.0, duration_s=3.0)
+    check(rec["errors"] == 0, "errors under load: %s" % rec)
+    check(rec["completed"] == rec["submitted"],
+          "admitted sequences dropped: %s" % rec)
+    check(rec["tokens"] > 0, "no tokens generated: %s" % rec)
+    check(rec["ttft_p99_ms"] is not None
+          and rec["ttft_p99_ms"] < 1500.0,
+          "TTFT p99 unbounded: %s" % rec)
+    check(rec["retrace_count"] <= rec["retrace_bound"],
+          "retraces exceed the prefill+decode ladder: %s" % rec)
+    check(rec["kv_occupancy_peak"] <= 1.0,
+          "KV occupancy over capacity: %s" % rec)
+    finish(bench=rec)
+
+if mode == "bluegreen":
+    models = []
+
+    def make_engine():
+        m = Transformer(CFG, seed=0)
+        models.append(m)
+        return DecodeEngine(m, replicas=1, prefill_ladder=(8,),
+                            decode_ladder=(1, 4), page_size=4,
+                            max_new_default=8, max_queue=4096)
+
+    bg = BlueGreenEngine(make_engine)
+    bg.generate(prompts[0], max_new_tokens=2,
+                timeout_s=300)  # warm the active color
+    counts = {"submitted": 0, "delivered": 0, "errors": 0}
+    finishes = {}
+    stop = threading.Event()
+
+    def load():
+        gens = []
+        while not stop.is_set():
+            try:
+                gens.append(bg.submit_generate(
+                    prompts[counts["submitted"] % 32],
+                    max_new_tokens=8))
+                counts["submitted"] += 1
+            except Overloaded:
+                time.sleep(0.01)   # typed backpressure: retry
+                continue
+            time.sleep(0.01)
+        for g in gens:
+            try:
+                doc = g.result(timeout=300)
+                counts["delivered"] += 1
+                finishes[doc["finish"]] = \
+                    finishes.get(doc["finish"], 0) + 1
+            except Exception:
+                counts["errors"] += 1
+
+    loader = threading.Thread(target=load)
+    loader.start()
+    time.sleep(0.4)
+    state1 = {"params": jax.tree.map(
+        lambda a: np.asarray(a) * 0.5, models[0].params)}
+    bg.set_params(state1, step=1)   # cutover 1, sequences mid-decode
+    time.sleep(0.4)
+    state2 = {"params": jax.tree.map(
+        lambda a: np.asarray(a) * 0.25, models[0].params)}
+    bg.set_params(state2, step=2)   # cutover 2, sequences mid-decode
+    time.sleep(0.4)
+    stop.set()
+    loader.join(timeout=300)
+    check(counts["submitted"] > 0, "no load ran")
+    check(counts["errors"] == 0, "sequences lost: %s" % counts)
+    check(counts["delivered"] == counts["submitted"],
+          "cutover dropped admitted sequences: %s" % counts)
+    check(bg.cutovers == 2, "cutovers=%d (want 2)" % bg.cutovers)
+    st = bg.stats()
+    check(st["outstanding"] == 0 and st["standby_outstanding"] == 0,
+          "a color still holds sequences after drain: %s"
+          % {k: st[k] for k in ("outstanding", "standby_outstanding")})
+    check(st["retrace_count"] <= st["retrace_bound"],
+          "retrace bound violated across cutovers: %s"
+          % {k: st[k] for k in ("retrace_count", "retrace_bound")})
+    for e in (bg.active, bg.standby):
+        try:
+            e.assert_no_leaks()
+        except AssertionError as ex:
+            check(False, "KV pages leaked across cutover: %s" % ex)
+    bg.close()
+    finish(**counts, cutovers=bg.cutovers, finishes=finishes)
+
+# mode == "chaos": typed failures only, zero leaked pages
+eng = DecodeEngine(Transformer(CFG), replicas=1, prefill_ladder=(8,),
+                   decode_ladder=(1, 4), page_size=4,
+                   max_new_default=8, max_queue=64)
+eng.generate(prompts[0], max_new_tokens=2, timeout_s=300)  # warm
+
+with faults.armed("decode.admit"):
+    try:
+        eng.submit_generate(prompts[1], max_new_tokens=4)
+        check(False, "decode.admit fault did not fire")
+    except FaultInjected:
+        pass
+with faults.armed("decode.kv_alloc"):
+    try:
+        eng.submit_generate(prompts[2], max_new_tokens=4)
+        check(False, "decode.kv_alloc fault did not fire")
+    except FaultInjected:
+        pass
+with faults.armed("decode.step"):
+    g = eng.submit_generate(prompts[3], max_new_tokens=8)
+    try:
+        g.result(timeout=120)
+        check(False, "decode.step fault did not surface")
+    except FaultInjected:
+        pass
+
+crng = np.random.default_rng(7)
+points = ("decode.admit", "decode.kv_alloc", "decode.step")
+typed = untyped = delivered = 0
+for trial in range(12):            # seeded randomized sweep
+    faults.inject(points[trial % 3], at=int(crng.integers(0, 3)),
+                  times=1)
+    gens = []
+    for _ in range(4):
+        try:
+            gens.append(eng.submit_generate(
+                prompts[int(crng.integers(0, 32))],
+                max_new_tokens=int(crng.integers(4, 9))))
+        except (FaultInjected, Overloaded):
+            typed += 1
+        except Exception as ex:
+            untyped += 1
+            failures.append("untyped admit failure: %r" % (ex,))
+    for g in gens:
+        try:
+            g.result(timeout=300)
+            delivered += 1
+        except (FaultInjected, Overloaded):
+            typed += 1
+        except Exception as ex:
+            untyped += 1
+            failures.append("untyped sequence failure: %r" % (ex,))
+    faults.clear()
+check(typed >= 1, "seeded chaos never fired")
+check(untyped == 0, "%d untyped failures under chaos" % untyped)
+doc = eng.generate(prompts[0], max_new_tokens=4, timeout_s=300)
+check(len(doc["generated"]) >= 1, "engine dead after chaos")
+eng.drain(timeout_s=300)     # closes admission, delivers the tail
+try:
+    eng.assert_no_leaks()      # the acceptance bar: zero leaked pages
+except AssertionError as ex:
+    check(False, "KV pages leaked after chaos: %s" % ex)
+st = eng.stats()
+check(st["retrace_count"] <= st["retrace_bound"],
+      "retrace bound violated under chaos: %s"
+      % {k: st[k] for k in ("retrace_count", "retrace_bound")})
+eng.close()
+finish(typed=typed, untyped=untyped, delivered=delivered,
+       kv=st["kv"])
+"""
+
+
 # The SLO gate's worker (round 22): a router fronting a 2-host pod
 # where ONE host is armed with a serve.predict delay fault.  Both
 # backends run the full SLO plane (DK_SLO + tail-based retention +
@@ -2668,6 +2875,71 @@ def run_router_gate(timeout=420):
     }
 
 
+def run_decode_gate(timeout=420):
+    """-> gate record for the decode-serving tier (round 23, see
+    _DECODE_WORKER): sustained mixed prefill+decode generation load
+    with bounded TTFT p99 and retraces within the prefill+decode
+    ladder bound, a mid-decode blue/green reload dropping zero
+    sequences (each finishes on the params it was admitted under), and
+    a seeded decode.* chaos sweep with typed-only failures and zero
+    leaked KV pages."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="dk_decode_gate_")
+    script = os.path.join(work, "worker.py")
+    with open(script, "w") as f:
+        f.write(_DECODE_WORKER.replace("%REPO%", repr(REPO)))
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("DK_COORD", "DK_FAULTS", "DK_OBS",
+                                     "DK_SERVE", "DK_DECODE",
+                                     "DK_ALERT"))
+                and k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    failures = []
+    detail = {}
+    t0 = time.time()
+    try:
+        for mode in ("load", "bluegreen", "chaos"):
+            p = subprocess.Popen([sys.executable, script, mode, work],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT,
+                                 env=base_env, text=True)
+            try:
+                out = p.communicate(timeout=timeout)[0]
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = p.communicate()[0]
+                failures.append(f"{mode}: HANG (killed at {timeout}s)")
+                continue
+            m = re.search(r"^DECODE_RESULT (\{.*\})$", out, re.M)
+            if m:
+                doc = json.loads(m.group(1))
+                detail[mode] = {k: v for k, v in doc.items()
+                                if k not in ("ok", "failures")}
+                failures.extend(f"{mode}: " + f
+                                for f in doc.get("failures", []))
+                if p.returncode != 0 and not doc.get("failures"):
+                    failures.append(f"{mode}: rc={p.returncode}")
+            else:
+                failures.append(f"{mode}: no DECODE_RESULT "
+                                f"(rc={p.returncode}): {out[-300:]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return {
+        "name": "decode_serving",
+        "metric": "continuous_batching_ttft_bluegreen_kv_chaos",
+        "value": 0.0 if failures else 1.0,
+        "threshold": 1.0,
+        "passed": not failures,
+        "platform": "cpu",
+        "seconds": round(time.time() - t0, 1),
+        "detail": detail,
+        "failures": failures,
+    }
+
+
 def run_slo_gate(timeout=420):
     """-> gate record for the request-level SLO engine (round 22, see
     _SLO_WORKER): a router + 2-host pod with one host's serve.predict
@@ -3802,10 +4074,12 @@ def run_sim_gate(timeout=600):
     20): every scenario script green in one CLI run (1000-host PS
     churn with kills/rejoins + a healed partition, focused partition
     heal, preemption storm, elastic relaunch waves, checkpoint GC
-    races, router failover under a load spike), the churn run under
-    its 60s wall budget, and second seeded runs of ``ps_churn``,
-    ``router_failover`` AND ``slo_burn`` replaying BIT-IDENTICALLY
-    (trace digest equality across separate processes)."""
+    races, router failover under a load spike, router failover under a
+    spike of long-running decode sequences with paged-KV admission),
+    the churn run under its 60s wall budget, and second seeded runs of
+    ``ps_churn``, ``router_failover``, ``router_decode_spike`` AND
+    ``slo_burn`` replaying BIT-IDENTICALLY (trace digest equality
+    across separate processes)."""
     t0 = time.time()
     failures = []
     detail = {}
@@ -3893,6 +4167,27 @@ def run_sim_gate(timeout=600):
                     "router_failover replay diverged: "
                     f"{rf.get('digest', '')[:16]} != "
                     f"{rf2.get('digest', '')[:16]}")
+        ds = next((r for r in doc.get("scenarios", [])
+                   if r.get("scenario") == "router_decode_spike"),
+                  None)
+        if ds is None or "error" in ds:
+            failures.append("router_decode_spike produced no verdict")
+        else:
+            if not ds.get("kv_rejections"):
+                failures.append(
+                    "router_decode_spike never exhausted a KV pool")
+            proc5, doc5 = _cli("--scenario", "router_decode_spike",
+                               "--seed", "0")
+            ds2 = (doc5.get("scenarios") or [{}])[0]
+            detail["decode_replay"] = {
+                "digest": ds2.get("digest", "")[:16],
+                "matches": ds2.get("digest") == ds.get("digest"),
+            }
+            if ds2.get("digest") != ds.get("digest"):
+                failures.append(
+                    "router_decode_spike replay diverged: "
+                    f"{ds.get('digest', '')[:16]} != "
+                    f"{ds2.get('digest', '')[:16]}")
         sb = next((r for r in doc.get("scenarios", [])
                    if r.get("scenario") == "slo_burn"), None)
         if sb is None or "error" in sb:
@@ -3973,6 +4268,15 @@ def main():
                          "traces, blue/green cutover under load, "
                          "autoscaler actuation/hysteresis) and print "
                          "its record")
+    ap.add_argument("--decode-only", action="store_true",
+                    help="run just the decode-serving gate (sustained "
+                         "mixed prefill+decode generation load with "
+                         "bounded TTFT p99 and retraces within the "
+                         "prefill+decode ladder, mid-decode "
+                         "blue/green reload with zero dropped "
+                         "sequences, seeded decode.* chaos sweep with "
+                         "typed-only failures and zero leaked KV "
+                         "pages) and print its record")
     ap.add_argument("--slo-only", action="store_true",
                     help="run just the request-level SLO gate (router "
                          "+ 2-host pod, one host's serve.predict "
@@ -4028,8 +4332,10 @@ def main():
                          "with kills/rejoins and a healed partition "
                          "under 60s wall, preemption storm, elastic "
                          "relaunch waves, GC races, router failover "
-                         "under a load spike — plus seeded ps_churn + "
-                         "router_failover replays that must be "
+                         "under a load spike, decode-sequence spike "
+                         "with paged-KV admission — plus seeded "
+                         "ps_churn + router_failover + "
+                         "router_decode_spike replays that must be "
                          "bit-identical) and print its record")
     ap.add_argument("--watchdog-only", action="store_true",
                     help="run just the perf-telemetry watchdog gate "
@@ -4089,6 +4395,11 @@ def main():
         print(json.dumps(route_gate, indent=1))
         return 0 if route_gate["passed"] else 1
 
+    if args.decode_only:
+        decode_gate = run_decode_gate()
+        print(json.dumps(decode_gate, indent=1))
+        return 0 if decode_gate["passed"] else 1
+
     if args.slo_only:
         slo_gate = run_slo_gate()
         print(json.dumps(slo_gate, indent=1))
@@ -4109,6 +4420,7 @@ def main():
     res["gates"].append(run_obs_gate())
     res["gates"].append(run_serving_gate())
     res["gates"].append(run_router_gate())
+    res["gates"].append(run_decode_gate())
     res["gates"].append(run_slo_gate())
     res["gates"].append(run_chaos_gate())
     res["gates"].append(run_diff_ckpt_gate())

@@ -253,4 +253,4 @@ def test_scenario_registry_matches_cli_choices():
     assert SCENARIOS.keys() == {"ps_churn", "partition_heal",
                                 "preemption_storm", "relaunch_waves",
                                 "gc_race", "router_failover",
-                                "slo_burn"}
+                                "router_decode_spike", "slo_burn"}
