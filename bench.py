@@ -725,6 +725,23 @@ def bench_decode_serving(peak=None, timeout_s=300):
         timeout_s=timeout_s)
 
 
+def bench_decode_survivability(peak=None, timeout_s=300):
+    """Decode survivability benchmark: a 2-replica ``DecodeEngine``
+    under ~2x offered overload with a batch/interactive priority mix
+    loses replica 0 a third of the way in
+    (``dist_keras_tpu.serving.bench --survivability``).  Reports the
+    recovered-sequence latency tax (teacher-forced replay is not
+    free), interactive p99 across the kill, the brownout shed rate,
+    and the ledger the gate enforces (zero errors, zero leaked
+    pages).  Same CPU-pinned subprocess harness as the other serving
+    rows; no reference counterpart for ``vs_baseline``."""
+    return _run_cpu_worker(
+        "decode_survivability",
+        argv=["-m", "dist_keras_tpu.serving.bench", "--survivability",
+              "--seconds", "4"],
+        timeout_s=timeout_s)
+
+
 # The router bench worker: the same single-row /predict measured
 # DIRECT against one backend vs ROUTED through a RouterServer over two
 # (the fabric hop's overhead), then a continuous routed stream with one
@@ -1672,6 +1689,8 @@ def main():
                                    "serving_cpu_offered_load"),
                                   (bench_decode_serving,
                                    "decode_serving"),
+                                  (bench_decode_survivability,
+                                   "decode_survivability"),
                                   (bench_router,
                                    "router_overhead"),
                                   (bench_ckpt_manifest,
@@ -1720,7 +1739,8 @@ def main():
                bench_averaging_mnist_cnn, bench_aeasgd_higgs,
                bench_downpour_mnist_cnn, bench_dynsgd_cifar,
                bench_adag_streamed, bench_serving,
-               bench_decode_serving, bench_router,
+               bench_decode_serving, bench_decode_survivability,
+               bench_router,
                bench_ckpt_manifest,
                bench_ckpt_async_save, bench_diff_ckpt,
                bench_retrace_proxy, bench_reshard_restore,

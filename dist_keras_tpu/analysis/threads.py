@@ -47,11 +47,17 @@ KNOWN_THREAD_ROOTS = {
     "serve.reload_watcher": "serving/reload.py:CheckpointWatcher._loop",
     "serve.http": "serving/server.py:ServingServer.serve_forever",
     "serve.http_handler": "~serving/server.py:_Handler.*",
-    "decode.worker": "serving/decode.py:DecodeEngine._worker_loop",
+    "decode.worker": "serving/decode.py:DecodeEngine._worker_main",
+    # survivability bench chaos timer (the Timer target is a lambda, so
+    # the registration site carries the annotation and this row seeds
+    # reachability at the function the lambda actually calls)
+    "bench.kill_timer": "~serving/decode.py:DecodeEngine.kill_replica",
     # serving router tier + autoscaler
     "route.http": "serving/router.py:RouterServer.serve_forever",
     "route.http_handler": "~serving/router.py:_Handler.*",
     "route.health": "serving/router.py:RouterServer._health_loop",
+    "route.hedge": "serving/router.py:RouterServer"
+                   "._hedged_generate.run",
     "serve.autoscaler": "serving/autoscale.py:ReplicaAutoscaler._loop",
     # coordination plane
     "coord.deadline": "resilience/coordination.py:with_deadline.run",

@@ -146,6 +146,13 @@ KNOWN_EVENTS = (
     "decode_admit", "decode_prefill", "decode_step",
     "decode_complete", "decode_cancel", "decode_error",
     "decode_drain", "decode_kernel_rejected",
+    # decode survivability (serving/decode.py): replica quarantine +
+    # sequence-level recovery, deadline rejection/expiry, brownout
+    # shedding, allocator self-check leak reports
+    "decode_quarantine", "decode_recover", "decode_deadline",
+    "decode_shed", "decode_kv_leak",
+    # router hedged retries + streaming relay (serving/router.py)
+    "route_hedge", "route_stream_error",
 )
 
 

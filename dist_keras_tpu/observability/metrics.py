@@ -324,6 +324,23 @@ KNOWN_METRICS = {
     "decode.step_s": "histogram",
     "decode.active": "gauge",
     "decode.kv_used_pages": "gauge",
+    # decode survivability plane (serving/decode.py): quarantine +
+    # sequence recovery, deadline admission/expiry, brownout shedding
+    # (shed is deliberately NOT folded into decode.rejected — the
+    # generate_tokens SLO reads rejected, and a shed that burned the
+    # SLO would amplify itself), and the periodic allocator self-check
+    "decode.quarantines": "counter",
+    "decode.recovered": "counter",
+    "decode.shed": "counter",
+    "decode.deadline_infeasible": "counter",
+    "decode.deadline_expired": "counter",
+    "decode.kv_leaked": "counter",
+    # router hedging (serving/router.py): hedged /generate forwards,
+    # first-wins outcomes, and budget denials
+    "route.hedges": "counter",
+    "route.hedge_wins": "counter",
+    "route.hedge_denied": "counter",
+    "route.stream_errors": "counter",
 }
 
 _lock = threading.Lock()

@@ -118,6 +118,13 @@ def summarize(events):
     ps_joins = []     # [{wid, rank, rejoined}] in timeline order
     ps_lapses = []    # [{wid, rank, reason}] in timeline order
     ps_rejected = 0   # over-cap commits refused (typed StaleCommit)
+    # decode survivability attribution (serving/decode.py): which
+    # replica died, how many sequences it carried, where they landed
+    dq = []           # quarantines [{replica, orphans, cause}]
+    dr = {}           # recoveries: dst replica -> count
+    dshed = {}        # brownout sheds: reason -> count
+    ddl = {"infeasible": 0, "expired": 0}
+    dleaks = 0        # self-check reclaimed pages
     nonfinite = 0
     for ev in events:
         rank = int(ev.get("rank", 0))
@@ -195,6 +202,23 @@ def summarize(events):
         elif kind == "ps_stale_scaled":
             if ev.get("rejected"):
                 ps_rejected += 1
+        elif kind == "decode_quarantine":
+            dq.append({"replica": ev.get("replica"),
+                       "orphans": ev.get("orphans"),
+                       "cause": ev.get("cause")})
+        elif kind == "decode_recover":
+            dst = ev.get("dst", "?")
+            dr[dst] = dr.get(dst, 0) + 1
+        elif kind == "decode_shed":
+            why = ev.get("reason", "?")
+            dshed[why] = dshed.get(why, 0) + 1
+        elif kind == "decode_deadline":
+            if ev.get("phase") == "admission":
+                ddl["infeasible"] += 1
+            else:
+                ddl["expired"] += 1
+        elif kind == "decode_kv_leak":
+            dleaks += int(ev.get("pages", 0) or 0)
         elif kind == "reshard_restore":
             reshards.append({
                 "rank": rank, "step": ev.get("step"),
@@ -228,6 +252,11 @@ def summarize(events):
                "staleness_hist": ps_staleness,
                "joins": ps_joins, "lapses": ps_lapses,
                "rejected_stale": ps_rejected},
+        "decode": {"quarantines": dq,
+                   "recoveries_by_replica": dr,
+                   "sheds_by_reason": dshed,
+                   "deadline": ddl,
+                   "kv_pages_reclaimed": dleaks},
     }
 
 
@@ -556,6 +585,34 @@ def render(directory, last_n=10):
         if ps["rejected_stale"]:
             lines.append(f"  over-cap commits refused (typed): "
                          f"{ps['rejected_stale']}")
+    dc = s["decode"]
+    if (dc["quarantines"] or dc["recoveries_by_replica"]
+            or dc["sheds_by_reason"] or any(dc["deadline"].values())
+            or dc["kv_pages_reclaimed"]):
+        lines.append("decode survivability:")
+        for q in dc["quarantines"]:
+            landed = sum(dc["recoveries_by_replica"].values())
+            lines.append(
+                f"  replica {q['replica']} quarantined "
+                f"({q['cause']}): {q['orphans']} in-flight "
+                f"sequence(s), {landed} recovered onto "
+                + (", ".join(
+                    f"replica {d} x{n}" for d, n in
+                    sorted(dc["recoveries_by_replica"].items(),
+                           key=lambda kv: str(kv[0])))
+                   or "nobody"))
+        if dc["sheds_by_reason"]:
+            lines.append("  brownout sheds: " + ", ".join(
+                f"{k} x{v}" for k, v in
+                sorted(dc["sheds_by_reason"].items())))
+        if any(dc["deadline"].values()):
+            lines.append(
+                f"  deadlines: {dc['deadline']['infeasible']} "
+                f"rejected at the door, "
+                f"{dc['deadline']['expired']} expired mid-decode")
+        if dc["kv_pages_reclaimed"]:
+            lines.append(f"  KV LEAK: self-check reclaimed "
+                         f"{dc['kv_pages_reclaimed']} page(s)")
     # the tail per host — what each host was doing when the run ended
     by_rank = {}
     for ev in events:

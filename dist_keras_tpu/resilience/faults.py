@@ -137,6 +137,11 @@ KNOWN_POINTS = (
     # serving/kv_cache.py) — appended after the router points for the
     # same replay-contract reason
     "decode.admit", "decode.step", "decode.kv_alloc",
+    # decode survivability (serving/decode.py) — appended last, same
+    # replay-contract reason: fires at the head of the quarantine
+    # re-admission path (a failed recovery resolves every orphan
+    # typed, never a hang)
+    "decode.recover",
 )
 
 

@@ -248,6 +248,142 @@ def run_decode_benchmark(offered_rps=40.0, duration_s=4.0, vocab=64,
     }
 
 
+def run_survivability_benchmark(offered_rps=60.0, duration_s=4.0,
+                                vocab=64, seq_len=64, d_model=32,
+                                n_heads=2, n_layers=2,
+                                prefill_ladder=(8, 16),
+                                decode_ladder=(1, 4, 8), page_size=8,
+                                max_new=12, replicas=2,
+                                max_queue=4096, batch_every=3,
+                                seed=0):
+    """Decode survivability under pressure: paced open-loop generation
+    against a multi-replica engine at roughly 2x the single-replica
+    comfortable rate (every ``batch_every``-th request
+    ``priority="batch"``), with replica 0 KILLED a third of the way
+    in.  -> JSON-ready record: the recovered-sequence latency tax
+    (recovered p50 vs undisturbed p50 — replay is not free, and this
+    row says what it costs), interactive sequence-latency p99 across
+    the kill, the brownout shed rate for batch work, and the
+    survivability ledger (quarantines, recoveries, zero errors, zero
+    leaked pages)."""
+    from dist_keras_tpu.models.transformer import (
+        Transformer,
+        transformer_config,
+    )
+    from dist_keras_tpu.serving.decode import DecodeEngine
+    from dist_keras_tpu.serving.engine import Overloaded
+
+    cfg = transformer_config(input_dim=int(vocab), seq_len=int(seq_len),
+                             d_model=int(d_model), n_heads=int(n_heads),
+                             n_layers=int(n_layers),
+                             n_classes=int(vocab))
+    engine = DecodeEngine(Transformer(cfg),
+                          replicas=max(2, int(replicas)),
+                          prefill_ladder=tuple(prefill_ladder),
+                          decode_ladder=tuple(decode_ladder),
+                          page_size=int(page_size),
+                          max_queue=int(max_queue))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, size=n).tolist()
+               for n in rng.integers(2, prefill_ladder[-1] + 1,
+                                     size=64)]
+    for rung in engine.prefill_ladder:  # zero compiles in the window
+        engine.generate(list(range(1, min(rung, vocab - 1) + 1))
+                        [:rung], max_new_tokens=2, timeout_s=300)
+
+    lat_lock = threading.Lock()
+    undisturbed, recovered = [], []
+    interactive = []
+    rejected = {"kv_exhausted": 0, "queue_full": 0}
+    shed = [0]
+    batch_offered = [0]
+    submitted = [0]
+
+    def _submit_one(i):
+        t0 = time.monotonic()
+        prio = "batch" if i % int(batch_every) == 0 else "interactive"
+
+        def _done(fut):
+            if fut.exception() is None:
+                doc = fut.result()  # dklint: ignore[unbounded-wait] done-callbacks run only after resolution
+                lat = time.monotonic() - t0
+                with lat_lock:
+                    (recovered if doc.get("recoveries")
+                     else undisturbed).append(lat)
+                    if prio == "interactive":
+                        interactive.append(lat)
+        if prio == "batch":
+            batch_offered[0] += 1
+        try:
+            gen = engine.submit_generate(prompts[i % len(prompts)],
+                                         max_new_tokens=max_new,
+                                         priority=prio)
+        except Overloaded as e:
+            if e.reason == "shed_batch":
+                shed[0] += 1
+            else:
+                rejected[e.reason] = rejected.get(e.reason, 0) + 1
+        else:
+            submitted[0] += 1
+            gen.future.add_done_callback(_done)
+
+    # dklint: thread-root=bench.kill_timer
+    killer = threading.Timer(float(duration_s) / 3.0,
+                             lambda: engine.kill_replica(0))
+    killer.daemon = True
+    killer.start()
+    interval = 1.0 / float(offered_rps)
+    t_start = time.monotonic()
+    next_t = t_start
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now - t_start >= duration_s:
+            break
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.005))
+            continue
+        _submit_one(i)
+        i += 1
+        next_t += interval
+    killer.cancel()
+    engine.drain(timeout_s=120)
+    wall = time.monotonic() - t_start
+    stats = engine.stats()
+    leaked = engine.self_check()
+    und_p50 = (_percentile(undisturbed, 50) * 1e3
+               if undisturbed else None)
+    rec_p50 = (_percentile(recovered, 50) * 1e3
+               if recovered else None)
+    return {
+        "mode": "decode_survivability",
+        "offered_rps": float(offered_rps),
+        "duration_s": round(wall, 3),
+        "submitted": submitted[0],
+        "completed": len(undisturbed) + len(recovered),
+        "recovered": len(recovered),
+        "quarantines": stats["quarantines"],
+        "errors": stats["errors"],
+        "rejected": int(sum(rejected.values())),
+        "shed": shed[0],
+        "shed_rate": (round(shed[0] / batch_offered[0], 4)
+                      if batch_offered[0] else None),
+        "undisturbed_p50_ms": (round(und_p50, 3)
+                               if und_p50 is not None else None),
+        "recovered_p50_ms": (round(rec_p50, 3)
+                             if rec_p50 is not None else None),
+        "recovery_tax": (round(rec_p50 / und_p50, 3)
+                         if rec_p50 is not None and und_p50
+                         else None),
+        "interactive_p99_ms": (
+            round(_percentile(interactive, 99) * 1e3, 3)
+            if interactive else None),
+        "kv_leaked_pages": leaked + stats["kv_leaked"],
+        "replicas": stats["replicas"],
+        "replicas_dead": stats["replicas_dead"],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--qps", type=float, default=400.0)
@@ -257,12 +393,21 @@ def main(argv=None):
     ap.add_argument("--decode", action="store_true",
                     help="measure decode serving (tokens/sec + TTFT) "
                          "instead of fixed-shape predict")
+    ap.add_argument("--survivability", action="store_true",
+                    help="measure decode survivability: replica kill "
+                         "mid-load, recovery latency tax, brownout "
+                         "shed rate")
     ap.add_argument("--rps", type=float, default=40.0,
                     help="offered generation requests/sec (--decode)")
     ap.add_argument("--max-new", type=int, default=12,
                     help="tokens generated per request (--decode)")
     args = ap.parse_args(argv)
-    if args.decode:
+    if args.survivability:
+        record = run_survivability_benchmark(
+            offered_rps=args.rps if args.rps != 40.0 else 60.0,
+            duration_s=args.seconds,
+            max_new=args.max_new)
+    elif args.decode:
         record = run_decode_benchmark(offered_rps=args.rps,
                                       duration_s=args.seconds,
                                       replicas=args.replicas,

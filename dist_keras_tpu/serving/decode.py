@@ -37,9 +37,35 @@ Design points (each mirrors an existing engine contract):
   params they started with, decode iterations simply group active
   sequences by params generation (at most a couple in flight).
 - **Typed errors, never hangs.**  The ``decode.admit`` /
-  ``decode.kv_alloc`` / ``decode.step`` fault points cover admission,
-  page reservation and the step dispatch; any failure lands typed on
-  the affected sequences' futures with their pages reclaimed.
+  ``decode.kv_alloc`` / ``decode.step`` / ``decode.recover`` fault
+  points cover admission, page reservation, the step dispatch and the
+  quarantine re-admission path; any failure lands typed on the
+  affected sequences' futures with their pages reclaimed.
+- **Sequence-level recovery.**  A replica worker crash (the
+  :meth:`DecodeEngine.kill_replica` chaos seam, or a ``decode.step``
+  fault past the in-place retry) QUARANTINES that replica: its KV
+  pages free, its in-flight sequences re-admit onto surviving
+  replicas and REPLAY — prefill over the prompt, then teacher-forced
+  decode steps over the already-generated tokens (the canonical
+  ``seq.tokens`` are kept; replayed predictions are discarded, so
+  streaming callbacks resume exactly where they stopped and the
+  final doc is bit-identical to an undisturbed greedy run).  Futures
+  never see the failure; only when NO survivor can hold a sequence
+  does it resolve typed (never a hang).  Whole-pod loss is out of
+  scope: killing the last live replica is refused.
+- **End-to-end deadlines.**  ``submit_generate(deadline_s=...)``
+  rejects at the door (``Overloaded("deadline_infeasible")``) when
+  the observed prefill/step EWMA says ``max_new_tokens`` cannot
+  finish in time; a deadline expiring mid-decode frees the slot and
+  its pages between steps and resolves the future with
+  ``finish="deadline"`` and the tokens produced so far.
+- **Brownout shedding.**  ``priority="batch"`` admissions are shed
+  typed (``Overloaded("shed_batch")``) while ``slo.breaching()`` or
+  KV occupancy sits above ``DK_DECODE_SHED_WATERMARK`` —
+  ``interactive`` traffic keeps its SLO through the brownout.
+  Sheds count ``decode.shed``, deliberately NOT ``decode.rejected``:
+  the ``generate_tokens`` SLO reads ``rejected``, and shedding that
+  burned the SLO would amplify itself.
 
 Observability: ``decode_*`` events at every seam, ``decode.*``
 registry metrics (TTFT and step-time histograms carry trace
@@ -65,6 +91,7 @@ import jax.numpy as jnp
 
 from dist_keras_tpu.models.transformer import layer_norm
 from dist_keras_tpu.observability import events, metrics, perf, spans
+from dist_keras_tpu.observability import slo as _slo
 from dist_keras_tpu.ops.pallas.decode_attention import (
     paged_attention_auto,
 )
@@ -75,10 +102,21 @@ from dist_keras_tpu.ops.pallas.flash_attention import (
 from dist_keras_tpu.resilience.faults import fault_point
 from dist_keras_tpu.serving.engine import Overloaded
 from dist_keras_tpu.serving.kv_cache import PagedKVCache, PagesExhausted
+from dist_keras_tpu.utils import knobs
 from dist_keras_tpu.utils.serialization import (
     deserialize_model,
     serialize_model,
 )
+
+
+class _ReplicaDead(Exception):
+    """Internal scheduler signal: this replica must quarantine (worker
+    crash, kill seam, or a step failure past the retry policy with a
+    survivor available).  Never escapes the engine."""
+
+    def __init__(self, cause):
+        self.cause = cause
+        super().__init__(str(cause))
 
 
 class _Sequence:
@@ -86,11 +124,13 @@ class _Sequence:
 
     __slots__ = ("sid", "tokens", "prompt_len", "max_new", "eos_id",
                  "future", "on_token", "t", "tw", "ctx", "params",
-                 "pages", "kv_len", "steps", "cancelled", "ttft_s",
-                 "t_first")
+                 "params_host", "pages", "kv_len", "steps", "cancelled",
+                 "ttft_s", "t_first", "deadline", "priority",
+                 "recoveries", "finished")
 
     def __init__(self, sid, tokens, max_new, eos_id, on_token, params,
-                 pages):
+                 params_host, pages, deadline=None,
+                 priority="interactive"):
         self.sid = sid
         self.tokens = list(tokens)
         self.prompt_len = len(tokens)
@@ -102,12 +142,17 @@ class _Sequence:
         self.tw = time.time()
         self.ctx = spans.capture()
         self.params = params      # pinned: reloads never touch us
+        self.params_host = params_host  # host ref: re-pin on recovery
         self.pages = pages
         self.kv_len = 0           # KV positions written so far
         self.steps = 0            # decode iterations consumed
         self.cancelled = False
         self.ttft_s = None
         self.t_first = None
+        self.deadline = deadline  # absolute monotonic, or None
+        self.priority = priority
+        self.recoveries = 0       # quarantine re-admissions survived
+        self.finished = False     # exit accounted (pages reclaimed)
 
     def generated(self):
         return self.tokens[self.prompt_len:]
@@ -120,6 +165,7 @@ class _Sequence:
             "steps": self.steps,
             "ttft_s": self.ttft_s,
             "finish": finish,
+            "recoveries": self.recoveries,
         }
 
 
@@ -149,6 +195,7 @@ class _DecodeReplica:
     def __init__(self, index, device, params, cache, kp, vp):
         self.index = index
         self.device = device
+        self.params_host = params
         self.params = (jax.device_put(params, device)
                        if device is not None else params)
         self.cache = cache
@@ -157,11 +204,30 @@ class _DecodeReplica:
         self.queue = collections.deque()
         self.active = []
         self.retiring = False
+        self.killed = False       # crash requested (kill_replica seam)
+        self.dead = False         # quarantined: out of service for good
         self.steps = 0
+        self._pinned = {}         # id(params_host) -> device params
 
     def put_params(self, params):
+        self.params_host = params
         self.params = (jax.device_put(params, self.device)
                        if self.device is not None else params)
+
+    def pin(self, params_host):
+        """Device-resident params for a recovered sequence's pinned
+        generation.  The common case (no reload since admission) reuses
+        this replica's current params; an older generation device-puts
+        once and caches (at most a couple of generations in flight —
+        the same bound the step grouping relies on)."""
+        if params_host is self.params_host:
+            return self.params
+        key = id(params_host)
+        if key not in self._pinned:
+            self._pinned[key] = (
+                jax.device_put(params_host, self.device)
+                if self.device is not None else params_host)
+        return self._pinned[key]
 
 
 class DecodeEngine:
@@ -186,12 +252,22 @@ class DecodeEngine:
       max_new_default: ``max_new_tokens`` when a request omits it.
       eos_id: default stop token (None = length-only stopping).
       devices: explicit device list (default ``jax.devices()``).
+      step_retries: in-place retries of a failed decode-step dispatch
+        (safe: pools and ``kv_len`` only advance on success).  Past
+        them the replica quarantines when a survivor exists, else the
+        group fails typed.
+      shed_watermark: KV occupancy fraction above which ``batch``
+        admissions shed (default: ``DK_DECODE_SHED_WATERMARK``).
+      self_check_interval_s: cadence of the scheduler's allocator
+        reconciliation pass (``decode.kv_leaked``).
     """
 
     def __init__(self, keras_model, replicas=None,
                  prefill_ladder=(16, 64), decode_ladder=(1, 4, 8),
                  page_size=8, num_pages=None, max_queue=256,
-                 max_new_default=16, eos_id=None, devices=None):
+                 max_new_default=16, eos_id=None, devices=None,
+                 step_retries=1, shed_watermark=None,
+                 self_check_interval_s=1.0):
         self.serialized = serialize_model(keras_model)
         model = deserialize_model(self.serialized)
         cfg = getattr(model, "cfg", None)
@@ -264,6 +340,20 @@ class DecodeEngine:
         self._rr = 0
         self._shapes = set()      # (phase, rung) dispatched
         self.reload_count = 0
+        self.step_retries = int(step_retries)
+        self._shed_watermark = float(
+            shed_watermark if shed_watermark is not None
+            else knobs.get("DK_DECODE_SHED_WATERMARK"))
+        self._self_check_interval = float(self_check_interval_s)
+        self._next_self_check = (time.monotonic()
+                                 + self._self_check_interval)
+        # recovered sequences waiting for survivor KV capacity: they
+        # hold no pages while pending; every worker iteration tries to
+        # place them (admission-identical worst-case reservation)
+        self._orphans = []
+        # observed wall EWMAs feeding deadline feasibility at the door
+        self._ewma_prefill = None
+        self._ewma_step = None
 
         # engine-local instruments + the shared process registry (the
         # same split ServingEngine documents: per-engine truths vs
@@ -276,12 +366,26 @@ class DecodeEngine:
         self._n_errors = 0
         self._n_cancelled = 0
         self._n_tokens = 0
+        self._n_quarantines = 0
+        self._n_recovered = 0
+        self._n_shed = 0
+        self._n_deadline_infeasible = 0
+        self._n_deadline_expired = 0
+        self._n_kv_leaked = 0
         self._reg_admitted = metrics.counter("decode.admitted")
         self._reg_completed = metrics.counter("decode.completed")
         self._reg_rejected = metrics.counter("decode.rejected")
         self._reg_errors = metrics.counter("decode.errors")
         self._reg_cancelled = metrics.counter("decode.cancelled")
         self._reg_tokens = metrics.counter("decode.tokens")
+        self._reg_quarantines = metrics.counter("decode.quarantines")
+        self._reg_recovered = metrics.counter("decode.recovered")
+        self._reg_shed = metrics.counter("decode.shed")
+        self._reg_deadline_infeasible = metrics.counter(
+            "decode.deadline_infeasible")
+        self._reg_deadline_expired = metrics.counter(
+            "decode.deadline_expired")
+        self._reg_kv_leaked = metrics.counter("decode.kv_leaked")
         self._reg_ttft = metrics.histogram("decode.ttft_s")
         self._reg_step = metrics.histogram("decode.step_s")
         self._reg_active = metrics.gauge("decode.active")
@@ -289,7 +393,7 @@ class DecodeEngine:
         perf.install()  # retrace listener: the ladder bound, verified
 
         self._workers = [threading.Thread(
-            target=self._worker_loop, args=(rep,), daemon=True,
+            target=self._worker_main, args=(rep,), daemon=True,
             name=f"dk-decode-worker-{rep.index}")
             for rep in self._replicas]
         for t in self._workers:
@@ -372,11 +476,15 @@ class DecodeEngine:
                 return b
         return None
 
+    def _live_replicas_locked(self):
+        return [r for r in self._replicas
+                if not r.retiring and not r.dead and not r.killed]
+
     def _pick_replica(self, needed_pages):
         """Most free pages wins (KV is the scarce resource), round-robin
-        on ties; retiring replicas are out of rotation.  Caller holds
-        the lock."""
-        live = [r for r in self._replicas if not r.retiring]
+        on ties; retiring and quarantined replicas are out of rotation.
+        Caller holds the lock."""
+        live = self._live_replicas_locked()
         if not live:
             return None, 0
         frees = [r.cache.stats()["free_pages"] for r in live]
@@ -389,13 +497,39 @@ class DecodeEngine:
                 return (live[i] if best >= needed_pages else None), best
         return None, best  # pragma: no cover - unreachable
 
+    def _should_shed_locked(self):
+        """Brownout verdict for a ``batch`` admission: KV occupancy
+        over the watermark, or any SLO objective firing.  Caller holds
+        the lock ( ``slo.breaching`` takes only leaf locks)."""
+        live = self._live_replicas_locked()
+        total = used = 0
+        for r in live:
+            st = r.cache.stats()
+            total += st["num_pages"]
+            used += st["used_pages"]
+        if total and used / total >= self._shed_watermark:
+            return "kv_watermark"
+        firing = _slo.breaching()
+        if firing:
+            return "slo:" + ",".join(firing)
+        return None
+
     def submit_generate(self, tokens, max_new_tokens=None, eos_id=None,
-                        on_token=None):
+                        on_token=None, deadline_s=None,
+                        priority="interactive"):
         """Admit one prompt; -> :class:`Generation` whose future
         resolves to the result doc (tokens, ttft_s, finish reason).
         Raises :class:`Overloaded` at the door (``queue_full`` /
-        ``kv_exhausted`` / ``draining`` / ``stopped``) and
-        ``ValueError`` for malformed prompts — rejected, never lost."""
+        ``kv_exhausted`` / ``draining`` / ``stopped`` /
+        ``deadline_infeasible`` / ``shed_batch``) and ``ValueError``
+        for malformed prompts — rejected, never lost.
+
+        ``deadline_s`` is the caller's end-to-end budget: infeasible
+        requests (per the observed prefill/step EWMAs) reject at the
+        door instead of burning KV pages toward a 504; expiry
+        mid-decode frees the slot between steps and resolves
+        ``finish="deadline"``.  ``priority`` is ``interactive``
+        (default) or ``batch``; ``batch`` sheds first in a brownout."""
         fault_point("decode.admit")
         toks = [int(t) for t in tokens]
         if not toks:
@@ -418,6 +552,15 @@ class DecodeEngine:
                 f"prompt + max_new_tokens = {total} exceeds the "
                 f"model's seq_len ({self.seq_len})")
         eos = self.eos_id if eos_id is None else int(eos_id)
+        if priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"priority={priority!r} must be 'interactive' or "
+                "'batch'")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise ValueError(
+                    f"deadline_s={deadline_s} must be > 0")
         with self._cond:
             if self._draining or self._stopped:
                 self._n_rejected += 1
@@ -430,6 +573,32 @@ class DecodeEngine:
                 raise Overloaded("queue_full",
                                  pending=self._outstanding,
                                  capacity=self.max_queue)
+            if priority == "batch":
+                shed_why = self._should_shed_locked()
+                if shed_why is not None:
+                    # counted decode.shed, NOT decode.rejected: the
+                    # generate_tokens SLO reads rejected, and a shed
+                    # that burned the SLO would amplify itself
+                    self._n_shed += 1
+                    self._reg_shed.inc()
+                    events.emit("decode_shed", reason=shed_why,
+                                prompt_len=len(toks))
+                    raise Overloaded("shed_batch",
+                                     pending=self._outstanding,
+                                     capacity=self.max_queue)
+            if deadline_s is not None \
+                    and self._ewma_prefill is not None \
+                    and self._ewma_step is not None:
+                est = self._ewma_prefill + max_new * self._ewma_step
+                if est > deadline_s:
+                    self._n_rejected += 1
+                    self._reg_rejected.inc()
+                    self._n_deadline_infeasible += 1
+                    self._reg_deadline_infeasible.inc()
+                    events.emit("decode_deadline", phase="admission",
+                                deadline_s=deadline_s,
+                                estimate_s=round(est, 6))
+                    raise Overloaded("deadline_infeasible")
             sid = next(self._seq_ids)
             needed = max(1, -(-total // self.page_size))
             rep, best_free = self._pick_replica(needed)
@@ -441,8 +610,12 @@ class DecodeEngine:
             # the allocator's own fault point (decode.kv_alloc) fires
             # inside; a raise here admits nothing and leaks nothing
             pages = rep.cache.alloc(sid, total)
-            seq = _Sequence(sid, toks, max_new, eos, on_token,
-                            rep.params, pages)
+            seq = _Sequence(
+                sid, toks, max_new, eos, on_token, rep.params,
+                rep.params_host, pages,
+                deadline=(None if deadline_s is None
+                          else time.monotonic() + deadline_s),
+                priority=priority)
             rep.queue.append(seq)
             self._outstanding += 1
             self._n_admitted += 1
@@ -468,7 +641,12 @@ class DecodeEngine:
         seq = generation._seq
         dequeued = False
         with self._cond:
-            if seq.future.done() or seq.cancelled:
+            if seq.future.done() or seq.cancelled or seq.finished:
+                # ``finished`` closes the race against _sequence_done:
+                # the scheduler already accounted the exit (pages
+                # reclaimed) and is about to resolve the future —
+                # nothing is left to cancel, and marking ``cancelled``
+                # here would be a lie the next pass can't act on
                 return False
             seq.cancelled = True
             # still queued on some replica? finish it here, never
@@ -497,8 +675,16 @@ class DecodeEngine:
     def _finish_locked(self, rep, seq, finish):
         """Account one sequence's exit (caller holds the lock):
         reclaim pages, bump counters.  The single reclamation seam for
-        complete/cancel/error — zero leaked pages by construction."""
+        complete/cancel/error/deadline — zero leaked pages by
+        construction."""
         rep.cache.free(seq.sid)
+        self._account_exit_locked(seq, finish)
+
+    def _account_exit_locked(self, seq, finish):
+        """The bookkeeping half of an exit — callers (quarantine)
+        whose pages were already reclaimed on the dead replica use
+        this directly."""
+        seq.finished = True
         self._outstanding -= 1
         if finish == "error":
             self._n_errors += 1
@@ -506,6 +692,12 @@ class DecodeEngine:
         elif finish == "cancelled":
             self._n_cancelled += 1
             self._reg_cancelled.inc()
+        elif finish == "deadline":
+            # a deadline expiry is the CALLER's budget running out —
+            # the future resolves with the tokens produced so far
+            # (graceful degradation), counted on its own meter
+            self._n_deadline_expired += 1
+            self._reg_deadline_expired.inc()
         elif finish == "stopped":
             # a close(drain=False) abort is a rejection, not a model
             # error — rejected-not-lost, same as the door
@@ -540,10 +732,15 @@ class DecodeEngine:
 
     def _prefill(self, rep, seq):
         """Run one admitted prompt through the prefill ladder; emits
-        the first generated token (TTFT) or fails the sequence typed."""
+        the first generated token (TTFT) or fails the sequence typed.
+
+        A RECOVERED sequence (``seq.tokens`` longer than the prompt)
+        replays the same prefill over the prompt only — its prediction
+        is a token the stream already delivered, so it is discarded
+        and the teacher-forced decode steps replay the rest."""
         rung = self._rung_for(seq.prompt_len, self.prefill_ladder)
         toks = np.zeros((rung,), np.int32)
-        toks[:seq.prompt_len] = seq.tokens
+        toks[:seq.prompt_len] = seq.tokens[:seq.prompt_len]
         scratch = rep.cache.scratch_page
         page_idx = np.full((rung,), scratch, np.int32)
         ps = self.page_size
@@ -573,19 +770,30 @@ class DecodeEngine:
         dt = time.perf_counter() - t0
         with self._cond:
             self._shapes.add(("prefill", rung))
+            self._ewma_prefill = (
+                dt if self._ewma_prefill is None
+                else 0.8 * self._ewma_prefill + 0.2 * dt)
         seq.kv_len = seq.prompt_len
-        seq.ttft_s = time.monotonic() - seq.t
-        seq.t_first = time.time()
-        ex = ((seq.ctx.trace_id, seq.ctx.span_id)
-              if seq.ctx is not None else None)
-        self._m_ttft.observe(seq.ttft_s, exemplar=ex)
-        self._reg_ttft.observe(seq.ttft_s, exemplar=ex)
+        replay = len(seq.tokens) > seq.prompt_len
+        if not replay:
+            seq.ttft_s = time.monotonic() - seq.t
+            seq.t_first = time.time()
+            ex = ((seq.ctx.trace_id, seq.ctx.span_id)
+                  if seq.ctx is not None else None)
+            self._m_ttft.observe(seq.ttft_s, exemplar=ex)
+            self._reg_ttft.observe(seq.ttft_s, exemplar=ex)
         if events.enabled():
             spans.span_at("serve.prefill", seq.ctx, tw0, time.time(),
                           rung=rung, replica=rep.index)
         events.emit("decode_prefill", sid=seq.sid, rung=rung,
                     replica=rep.index, duration_s=dt,
-                    ttft_s=seq.ttft_s)
+                    ttft_s=seq.ttft_s, replay=replay)
+        if replay:
+            # the first generated token was emitted before the crash;
+            # the replayed prediction is that same token (greedy,
+            # pinned params) — discard it, the canonical seq.tokens
+            # drive the teacher-forced catch-up steps
+            return
         self._emit_token(seq, first)
         finish = self._sequence_done(seq, first)
         if finish is not None:
@@ -599,8 +807,17 @@ class DecodeEngine:
 
     def _step_group(self, rep, group):
         """One decode step for ``group`` (same pinned params), padded
-        to a decode-ladder rung.  A failing step fails exactly this
-        group's sequences, typed, pages reclaimed."""
+        to a decode-ladder rung.  A failed dispatch retries IN PLACE
+        (``step_retries`` — safe: pools and ``kv_len`` only advance on
+        success); past the retries the replica quarantines when a
+        survivor exists (the group migrates and replays), else it
+        fails exactly this group's sequences, typed, pages reclaimed.
+
+        The input token is ``seq.tokens[seq.kv_len]`` — the last token
+        in steady state, a teacher-forced KNOWN token while a
+        recovered sequence catches back up (its predictions are
+        discarded until ``kv_len`` reaches the frontier, so streams
+        never see a duplicate)."""
         rung = self._rung_for(len(group), self.decode_ladder)
         scratch = rep.cache.scratch_page
         ps = self.page_size
@@ -612,32 +829,50 @@ class DecodeEngine:
         woff = np.zeros((rung,), np.int32)
         lengths = np.zeros((rung,), np.int32)
         for i, seq in enumerate(group):
-            toks[i] = seq.tokens[-1]
+            toks[i] = seq.tokens[seq.kv_len]
             positions[i] = seq.kv_len
             tables[i, :len(seq.pages)] = seq.pages
             wpage[i] = seq.pages[seq.kv_len // ps]
             woff[i] = seq.kv_len % ps
             lengths[i] = seq.kv_len + 1
         t0 = time.perf_counter()
-        try:
-            fault_point("decode.step")
-            perf.count_dispatch()
-            nxt, rep.kp, rep.vp = self._decode_jit(
-                group[0].params, rep.kp, rep.vp, jnp.asarray(toks),
-                jnp.asarray(positions), jnp.asarray(tables),
-                jnp.asarray(wpage), jnp.asarray(woff),
-                jnp.asarray(lengths))
-            nxt = np.asarray(nxt)
-        # dklint: ignore[broad-except] a failed step lands TYPED on every future in the group, pages reclaimed
-        except Exception as e:
+        err = None
+        for attempt in range(1 + self.step_retries):
+            try:
+                fault_point("decode.step")
+                perf.count_dispatch()
+                nxt, rep.kp, rep.vp = self._decode_jit(
+                    group[0].params, rep.kp, rep.vp, jnp.asarray(toks),
+                    jnp.asarray(positions), jnp.asarray(tables),
+                    jnp.asarray(wpage), jnp.asarray(woff),
+                    jnp.asarray(lengths))
+                nxt = np.asarray(nxt)
+                err = None
+                break
+            # dklint: ignore[broad-except] a failed step retries in place, then quarantines or lands TYPED
+            except Exception as e:
+                err = e
+                if attempt < self.step_retries:
+                    events.emit("decode_error", where="step_retry",
+                                n=len(group), replica=rep.index,
+                                attempt=attempt,
+                                error=type(e).__name__)
+        if err is not None:
+            with self._cond:
+                survivors = [r for r in self._live_replicas_locked()
+                             if r is not rep]
+            if survivors:
+                # a peer can hold this work: quarantine this replica,
+                # migrate + replay — the futures never see the failure
+                raise _ReplicaDead(err)
             with self._cond:
                 for seq in group:
                     rep.active.remove(seq)
                     self._finish_locked(rep, seq, "error")
             events.emit("decode_error", where="step", n=len(group),
-                        replica=rep.index, error=type(e).__name__)
+                        replica=rep.index, error=type(err).__name__)
             for seq in group:
-                self._resolve(seq, None, error=e)
+                self._resolve(seq, None, error=err)
             return
         dt = time.perf_counter() - t0
         rep.steps += 1
@@ -645,12 +880,18 @@ class DecodeEngine:
         self._reg_step.observe(dt)
         with self._cond:
             self._shapes.add(("decode", rung))
+            self._ewma_step = (dt if self._ewma_step is None
+                               else 0.8 * self._ewma_step + 0.2 * dt)
         events.emit("decode_step", replica=rep.index, rung=rung,
                     n=len(group), duration_s=dt)
         finished = []
         for i, seq in enumerate(group):
             seq.kv_len += 1
             seq.steps += 1
+            if seq.kv_len < len(seq.tokens):
+                # replay catch-up: this prediction is a token the
+                # stream already delivered before the crash — discard
+                continue
             self._emit_token(seq, int(nxt[i]))
             finish = self._sequence_done(seq, int(nxt[i]))
             if finish is not None:
@@ -667,52 +908,303 @@ class DecodeEngine:
                             steps=seq.steps)
                 self._resolve(seq, finish)
 
+    def _worker_main(self, rep):
+        """Thread body: the scheduler loop plus the crash boundary.
+        ANY escape — the :class:`_ReplicaDead` signal (kill seam, step
+        failure past retries) or an unexpected scheduler bug —
+        quarantines the replica so its sequences migrate or resolve
+        typed instead of hanging on a silently dead thread."""
+        try:
+            self._worker_loop(rep)
+        except _ReplicaDead as e:
+            self._quarantine(rep, e.cause)
+        # dklint: ignore[broad-except] a crashed worker quarantines its replica; sequences migrate or land typed, never hang
+        except Exception as e:
+            self._quarantine(rep, e)
+
     def _worker_loop(self, rep):
         while True:
-            admitted = []
+            dropped = []
             with self._cond:
+                # pending orphans hold the park open: an idle replica
+                # has its whole (homogeneous) pool free, so the next
+                # placement pass below always lands them
                 while (not rep.queue and not rep.active
-                       and not self._stopped and not rep.retiring):
+                       and not self._orphans
+                       and not self._stopped and not rep.retiring
+                       and not rep.killed):
                     # the scheduler's idle park: deliberately unbounded
                     # — every admit, cancel and both lifecycle exits
                     # notify this cond, and the predicate re-checks
-                    # stop/retire on wake
+                    # stop/retire/kill on wake
                     # dklint: ignore[unbounded-wait] idle park; admission and lifecycle exits notify this cond
                     self._cond.wait()
                 if self._stopped:
                     break
+                if rep.killed:
+                    raise _ReplicaDead(Overloaded("replica_lost"))
                 if rep.retiring and not rep.queue and not rep.active:
                     break
-                # retire cancelled actives, refill free slots — the
-                # continuous-batching seam: between iterations, never
-                # a batch barrier
-                cancelled = [s for s in rep.active if s.cancelled]
-                for seq in cancelled:
-                    rep.active.remove(seq)
-                    self._finish_locked(rep, seq, "cancelled")
+                o_migrated, o_dropped = \
+                    self._try_place_orphans_locked()
+                # retire cancelled and deadline-expired actives, refill
+                # free slots — the continuous-batching seam: between
+                # iterations, never a batch barrier.  An expired
+                # deadline frees the slot HERE, between steps.
+                now = time.monotonic()
+                for seq in list(rep.active):
+                    fin = ("cancelled" if seq.cancelled else
+                           "deadline" if seq.deadline is not None
+                           and now > seq.deadline else None)
+                    if fin is not None:
+                        rep.active.remove(seq)
+                        self._finish_locked(rep, seq, fin)
+                        dropped.append((seq, fin))
                 while rep.queue and len(rep.active) < self.max_slots:
                     seq = rep.queue.popleft()
-                    if seq.cancelled:
-                        self._finish_locked(rep, seq, "cancelled")
-                        cancelled.append(seq)
+                    fin = ("cancelled" if seq.cancelled else
+                           "deadline" if seq.deadline is not None
+                           and now > seq.deadline else None)
+                    if fin is not None:
+                        self._finish_locked(rep, seq, fin)
+                        dropped.append((seq, fin))
                         continue
                     rep.active.append(seq)
-                    admitted.append(seq)
-            for seq in cancelled:
-                events.emit("decode_cancel", sid=seq.sid,
-                            generated=len(seq.generated()))
-                self._resolve(seq, "cancelled")
-            for seq in admitted:
+                # prefill candidates by state, not by admission order:
+                # a recovered sequence re-enters here with kv_len == 0
+                # and replays exactly like a fresh admission
+                prefills = [s for s in rep.active if s.kv_len == 0]
+            for seq, target in o_migrated:
+                self._reg_recovered.inc()
+                events.emit("decode_recover", sid=seq.sid, src=None,
+                            dst=target.index,
+                            generated=len(seq.generated()),
+                            recoveries=seq.recoveries)
+            dropped.extend(o_dropped)
+            for seq, fin in dropped:
+                if fin == "cancelled":
+                    events.emit("decode_cancel", sid=seq.sid,
+                                generated=len(seq.generated()))
+                else:
+                    events.emit("decode_deadline", sid=seq.sid,
+                                phase="expiry",
+                                generated=len(seq.generated()))
+                self._resolve(seq, fin)
+            for seq in prefills:
                 self._prefill(rep, seq)
+                if rep.killed:
+                    raise _ReplicaDead(Overloaded("replica_lost"))
             with self._cond:
                 # group by pinned params generation: a hot reload means
                 # at most a couple of groups until old sequences drain
                 groups = {}
                 for seq in rep.active:
+                    if seq.kv_len == 0:
+                        continue  # not prefilled yet: next pass
                     groups.setdefault(id(seq.params), []).append(seq)
                 work = list(groups.values())
             for group in work:
                 self._step_group(rep, group)
+                if rep.killed:
+                    raise _ReplicaDead(Overloaded("replica_lost"))
+            self._maybe_self_check()
+
+    # -- survivability: quarantine + sequence-level recovery ------------
+    def kill_replica(self, index):
+        """Chaos seam: crash one replica worker (the thread analogue
+        of SIGKILL on a replica process).  The worker observes the
+        flag at its next seam, quarantines the replica — pages freed,
+        in-flight sequences re-admitted onto survivors and replayed —
+        and exits.  Refused (``ValueError``) for the LAST live
+        replica: whole-pod loss is the job scheduler's problem, not a
+        survivable event."""
+        with self._cond:
+            rep = next((r for r in self._replicas
+                        if r.index == int(index)), None)
+            if rep is None or rep.dead or rep.killed:
+                raise ValueError(
+                    f"kill_replica({index}): no such live replica")
+            live = self._live_replicas_locked()
+            if rep in live and len(live) <= 1:
+                raise ValueError(
+                    "kill_replica: refusing to kill the last live "
+                    "replica (whole-pod loss is out of scope)")
+            rep.killed = True
+            self._cond.notify_all()
+        return rep.index
+
+    def _place_locked(self, seq):
+        """Re-admission placement (caller holds the lock): the
+        surviving replica with the most free pages that can hold the
+        sequence's WORST-CASE reservation — the same door contract as
+        submit_generate.  -> the replica, or None when nowhere fits."""
+        total = seq.prompt_len + seq.max_new
+        live = [r for r in self._live_replicas_locked()]
+        live.sort(key=lambda r: -r.cache.stats()["free_pages"])
+        for rep in live:
+            try:
+                seq.pages = rep.cache.alloc(seq.sid, total)
+            except PagesExhausted:
+                continue
+            return rep
+        return None
+
+    def _fits_somewhere_locked(self, seq):
+        """Could ANY live replica's whole pool hold this sequence's
+        worst-case reservation?  If yes, a full-but-alive pool is a
+        capacity wait, not a loss."""
+        total = seq.prompt_len + seq.max_new
+        return any(r.cache.pages_for(total) <= r.cache.num_pages
+                   for r in self._live_replicas_locked())
+
+    def _try_place_orphans_locked(self):
+        """Place pending orphans — recovered sequences waiting for
+        survivor capacity (caller holds the lock).  They hold NO
+        pages while pending; placement reserves worst-case, exactly
+        like admission.  -> (migrated, dropped) pairs for the caller
+        to emit events / resolve futures OUTSIDE the lock."""
+        migrated, dropped = [], []
+        if not self._orphans:
+            return migrated, dropped
+        now = time.monotonic()
+        still = []
+        for seq in self._orphans:
+            fin = ("cancelled" if seq.cancelled else
+                   "deadline" if seq.deadline is not None
+                   and now > seq.deadline else None)
+            if fin is not None:
+                self._account_exit_locked(seq, fin)
+                dropped.append((seq, fin))
+                continue
+            target = self._place_locked(seq)
+            if target is None:
+                still.append(seq)
+                continue
+            seq.kv_len = 0          # replay regenerates the KV
+            seq.recoveries += 1
+            seq.params = target.pin(seq.params_host)
+            target.queue.append(seq)
+            self._n_recovered += 1
+            migrated.append((seq, target))
+        self._orphans[:] = still
+        if migrated:
+            self._cond.notify_all()
+        return migrated, dropped
+
+    def _quarantine(self, rep, cause):
+        """Take a crashed replica out of service and carry its
+        sequences over: free every page it held, re-admit each
+        in-flight sequence onto a survivor (``kv_len`` reset — the
+        replay machinery regenerates its KV from the canonical
+        tokens), park what fits a survivor's pool but not its current
+        free list (placed as capacity frees), and resolve typed only
+        what no survivor could EVER hold.  Futures never hang; pages
+        never leak."""
+        with self._cond:
+            rep.killed = True
+            rep.dead = True
+            rep.retiring = True     # out of _pick_replica rotation
+            orphans = list(rep.active) + list(rep.queue)
+            del rep.active[:]
+            rep.queue.clear()
+            for seq in orphans:
+                rep.cache.free(seq.sid)
+            self._n_quarantines += 1
+            self._cond.notify_all()
+        self._reg_quarantines.inc()
+        events.emit("decode_quarantine", replica=rep.index,
+                    orphans=len(orphans), cause=type(cause).__name__)
+        recover_err = None
+        try:
+            fault_point("decode.recover")
+        # dklint: ignore[broad-except] a failed recovery resolves every orphan TYPED — never a hang
+        except Exception as e:
+            recover_err = e
+        migrated = []
+        resolved = []
+        with self._cond:
+            for seq in orphans:
+                if seq.cancelled:
+                    self._account_exit_locked(seq, "cancelled")
+                    resolved.append((seq, "cancelled", None))
+                    continue
+                target = (None if recover_err is not None
+                          else self._place_locked(seq))
+                if target is None:
+                    if recover_err is None \
+                            and self._fits_somewhere_locked(seq):
+                        # survivors exist but are full RIGHT NOW: the
+                        # sequence was already admitted (door contract
+                        # honoured once), so it WAITS for capacity
+                        # instead of failing — futures never see a
+                        # survivable crash
+                        self._orphans.append(seq)
+                        continue
+                    # no survivor can EVER hold it (or recovery itself
+                    # is the injected failure): typed, never hung
+                    err = recover_err if recover_err is not None \
+                        else cause
+                    if not isinstance(err, BaseException):
+                        err = Overloaded("replica_lost")
+                    self._account_exit_locked(seq, "error")
+                    resolved.append((seq, None, err))
+                    continue
+                seq.kv_len = 0          # replay regenerates the KV
+                seq.recoveries += 1
+                seq.params = target.pin(seq.params_host)
+                target.queue.append(seq)
+                migrated.append((seq, target))
+                self._n_recovered += 1
+            self._cond.notify_all()
+        for seq, target in migrated:
+            self._reg_recovered.inc()
+            events.emit("decode_recover", sid=seq.sid,
+                        src=rep.index, dst=target.index,
+                        generated=len(seq.generated()),
+                        recoveries=seq.recoveries)
+        for seq, fin, err in resolved:
+            if fin == "cancelled":
+                events.emit("decode_cancel", sid=seq.sid,
+                            generated=len(seq.generated()))
+            else:
+                events.emit("decode_error", sid=seq.sid,
+                            where="quarantine",
+                            error=type(err).__name__)
+            self._resolve(seq, fin, error=err)
+
+    def _maybe_self_check(self):
+        now = time.monotonic()
+        with self._cond:
+            if now < self._next_self_check:
+                return
+            self._next_self_check = now + self._self_check_interval
+        self.self_check()
+
+    def self_check(self):
+        """Reconcile every allocator against the sequences the
+        scheduler actually holds — the periodic backstop behind
+        :meth:`assert_no_leaks`.  An allocation owned by NO queued or
+        active sequence is a leak: reclaimed here, counted on
+        ``decode.kv_leaked``, and reported so the gate fails loudly
+        instead of the pool quietly shrinking.  -> pages reclaimed."""
+        leaked = 0
+        stale = []
+        with self._cond:
+            for rep in self._replicas:
+                owned = {s.sid for s in rep.active}
+                owned.update(s.sid for s in rep.queue)
+                for sid in rep.cache.sequence_ids():
+                    if sid not in owned:
+                        n = rep.cache.free(sid)
+                        leaked += n
+                        stale.append((rep.index, sid, n))
+            if leaked:
+                self._n_kv_leaked += leaked
+        for rep_index, sid, n in stale:
+            self._reg_kv_leaked.inc(n)
+            events.emit("decode_kv_leak", replica=rep_index, sid=sid,
+                        pages=n)
+        return leaked
 
     # -- hot reload -----------------------------------------------------
     def set_params(self, state, step=None):
@@ -760,7 +1252,7 @@ class DecodeEngine:
                     rep = self._make_replica(idx)
                     self._replicas.append(rep)
                     t = threading.Thread(
-                        target=self._worker_loop, args=(rep,),
+                        target=self._worker_main, args=(rep,),
                         daemon=True, name=f"dk-decode-worker-{idx}")
                     self._workers.append(t)
                     started.append(t)
@@ -835,7 +1327,13 @@ class DecodeEngine:
                 del rep.active[:]
             for rep, seq in orphans:
                 self._finish_locked(rep, seq, "stopped")
+            pending = list(self._orphans)
+            self._orphans[:] = []
+            for seq in pending:   # page-less: bookkeeping half only
+                self._account_exit_locked(seq, "stopped")
         for _, seq in orphans:
+            self._resolve(seq, None, error=Overloaded("stopped"))
+        for seq in pending:
             self._resolve(seq, None, error=Overloaded("stopped"))
 
     def __enter__(self):
@@ -891,7 +1389,8 @@ class DecodeEngine:
             active = sum(len(r.active) for r in self._replicas)
             outstanding = self._outstanding
             shapes = sorted(self._shapes)
-            live = sum(1 for r in self._replicas if not r.retiring)
+            live = len(self._live_replicas_locked())
+            dead = sum(1 for r in self._replicas if r.dead)
         return {
             "replicas": live,
             "prefill_ladder": list(self.prefill_ladder),
@@ -907,6 +1406,14 @@ class DecodeEngine:
             "errors": self._n_errors,
             "cancelled": self._n_cancelled,
             "tokens": self._n_tokens,
+            "quarantines": self._n_quarantines,
+            "recovered": self._n_recovered,
+            "shed": self._n_shed,
+            "deadline_infeasible": self._n_deadline_infeasible,
+            "deadline_expired": self._n_deadline_expired,
+            "kv_leaked": self._n_kv_leaked,
+            "orphans_pending": len(self._orphans),
+            "replicas_dead": dead,
             "reloads": self.reload_count,
             "shapes_dispatched": shapes,
             # the no-retrace bound: prefill rungs + decode rungs ever

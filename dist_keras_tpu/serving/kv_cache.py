@@ -130,6 +130,13 @@ class PagedKVCache:
         with self._lock:
             return seq_id in self._held
 
+    def sequence_ids(self):
+        """Sequence ids currently holding pages — the engine's
+        periodic self-check reconciles this against the sequences the
+        scheduler actually owns (anything unowned is a leak)."""
+        with self._lock:
+            return tuple(self._held)
+
     def used_pages(self):
         with self._lock:
             return self.num_pages - len(self._free)

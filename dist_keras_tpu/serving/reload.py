@@ -124,7 +124,8 @@ class BlueGreenEngine:
             rows, timeout_s=timeout_s)
 
     def submit_generate(self, tokens, max_new_tokens=None, eos_id=None,
-                        on_token=None):
+                        on_token=None, deadline_s=None,
+                        priority="interactive"):
         # decode passthrough (DecodeEngine colors): same atomic-read
         # race rule — a generation lands WHOLE in one color; after a
         # cutover the old color finishes every sequence it admitted on
@@ -132,7 +133,8 @@ class BlueGreenEngine:
         # so a mid-decode rollout never drops a sequence
         return self._engines[self._active_idx].submit_generate(
             tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
-            on_token=on_token)
+            on_token=on_token, deadline_s=deadline_s,
+            priority=priority)
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
                  timeout_s=None):

@@ -47,6 +47,30 @@ never a hang, never a silent drop: requests a backend ADMITTED are
 the backend's no-drop contract; requests the router could not place
 are whole-request retries for the caller.
 
+``POST /generate`` adds three survivability layers on top:
+
+- **Deadline/priority propagation.**  ``x-dk-deadline-s`` and
+  ``x-dk-priority`` request headers forward verbatim to the backend,
+  whose admission turns an infeasible deadline into a typed 503 at
+  the door instead of a burned decode slot.
+- **Hedged retries under a budget.**  A non-streaming ``/generate``
+  still unanswered past the observed ``route.forward_s`` tail
+  (``DK_ROUTE_HEDGE_QUANTILE``) launches ONE duplicate on a sibling;
+  first complete answer wins and the loser is CANCELLED (the hedge
+  hop runs the backend's streaming surface, so closing the loser's
+  socket makes its next token write fail and the backend reclaims
+  the slot + KV pages through its own cancel path).  A token-bucket
+  budget (``DK_ROUTE_HEDGE_BUDGET`` tokens earned per request) caps
+  hedges to a fraction of traffic — a brownout cannot be amplified
+  into a retry storm (``route.hedges`` / ``route.hedge_wins`` /
+  ``route.hedge_denied``).
+- **Streaming relay with typed loss.**  ``stream: true`` bodies relay
+  chunk-for-chunk; a backend dying MID-STREAM ends the response with
+  a final typed NDJSON record ``{"error": "backend_stream_lost",
+  "retryable": true}`` instead of a truncated stream
+  (``route.stream_errors`` / ``route_stream_error``), and the death
+  counts as forward evidence against the backend.
+
 Tracing: the router parses the caller's ``traceparent``, opens one
 ``route.forward`` span, and forwards ITS traceparent to the backend —
 whose ``serve.request`` span (and the batcher/replica stage spans
@@ -62,7 +86,9 @@ fail the same way); only connect-level failures and backend 503s
 
 from __future__ import annotations
 
+import http.client
 import json
+import queue as _queue
 import threading
 import urllib.error
 import urllib.request
@@ -111,6 +137,36 @@ class NoBackends(RuntimeError):
         self.total = int(total)
         super().__init__(
             f"no live backends ({live} live of {total} known)")
+
+
+class _HedgeBudget:
+    """Token-bucket retry budget for hedged requests: every forwarded
+    request EARNS ``ratio`` tokens (capped at ``cap``), every hedge
+    SPENDS one — so hedges are bounded to roughly ``ratio`` of traffic
+    no matter how bad the tail gets, and a brownout can never be
+    amplified into a retry storm (the classic hedged-request guard)."""
+
+    def __init__(self, ratio=None, cap=10.0):
+        self.ratio = float(ratio if ratio is not None
+                           else knobs.get("DK_ROUTE_HEDGE_BUDGET"))
+        self.cap = float(cap)
+        self._tokens = self.cap   # a warm start: first hedges allowed
+        self._lock = threading.Lock()
+
+    def earn(self):
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_spend(self):
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def tokens(self):
+        with self._lock:
+            return self._tokens
 
 
 class _Backend:
@@ -403,6 +459,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(503, {"error": "draining"}, retry_after=1)
             return
         _metrics.counter("route.requests").inc()
+        # end-to-end deadline + priority ride headers across the hop
+        # (the body forwards verbatim, so headers are the only channel
+        # that survives without a rewrite)
+        fwd_headers = {}
+        for h in ("x-dk-deadline-s", "x-dk-priority"):
+            v = self.headers.get(h)
+            if v is not None:
+                fwd_headers[h] = v
         # the forward hop runs under ONE route.forward span continuing
         # the caller's trace; the traceparent sent DOWN names this span,
         # so the backend's serve.request parents to the router's hop —
@@ -411,9 +475,29 @@ class _Handler(BaseHTTPRequestHandler):
         with spans.resume(ctx):
             with spans.span("route.forward", n_bytes=len(body)):
                 self._trace_header = spans.traceparent()
-                code, payload, ctype, retry_after = srv.forward(
-                    body, path=path)
+                if path == "/generate" and _wants_stream(body):
+                    # streaming relay replies chunked from inside —
+                    # including the typed final record on backend loss
+                    srv.relay_stream(self, body, headers=fwd_headers)
+                    return
+                if path == "/generate":
+                    code, payload, ctype, retry_after = \
+                        srv.forward_generate(body, headers=fwd_headers)
+                else:
+                    code, payload, ctype, retry_after = srv.forward(
+                        body, path=path, headers=fwd_headers)
         self._reply_bytes(code, payload, ctype, retry_after=retry_after)
+
+
+def _wants_stream(body):
+    """True when a ``/generate`` body asks for token streaming (a
+    bare token list never does; unparseable bodies fall through to
+    the buffered path, whose backend will 400 them typed)."""
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return False
+    return isinstance(doc, dict) and bool(doc.get("stream", False))
 
 
 class RouterServer(ThreadingHTTPServer):
@@ -448,6 +532,7 @@ class RouterServer(ThreadingHTTPServer):
             attempts=2, backoff=0.02, jitter=0.0,
             retryable=(ForwardError,), name="route.forward")
         self._m_forward = _metrics.histogram("route.forward_s")
+        self._hedge_budget = _HedgeBudget()
         # lifecycle guard: BaseServer.shutdown() BLOCKS FOREVER unless
         # serve_forever is actually running — same hazard and cure as
         # ServingServer
@@ -464,7 +549,7 @@ class RouterServer(ThreadingHTTPServer):
         return self.server_address[:2]
 
     # -- forwarding -----------------------------------------------------
-    def forward(self, body, path="/predict"):
+    def forward(self, body, path="/predict", headers=None):
         """Place one ``/predict`` or ``/generate`` body on a live
         backend; -> (status, body bytes, content type, retry_after).
         Connect failures and backend 503s burn the attempt and move to
@@ -473,9 +558,12 @@ class RouterServer(ThreadingHTTPServer):
         either lands whole or is typed-rejected at the backend's door
         (``/generate`` included: a 503 ``kv_exhausted`` moves the
         request to a sibling with free pages).  Exhaustion and an empty
-        pool are typed 503 + Retry-After.  The router forwards
-        ``/generate`` BATCHED — token streaming is a direct-to-host
-        feature (the hop buffers a chunked response whole)."""
+        pool are typed 503 + Retry-After.  ``headers`` carries hop
+        headers (``x-dk-deadline-s`` / ``x-dk-priority``) verbatim.
+        Non-streaming ``/generate`` goes through
+        :meth:`forward_generate` (hedging); ``stream: true`` bodies
+        through :meth:`relay_stream` (chunk-for-chunk with a typed
+        final record on backend loss)."""
         t0 = _world.monotonic()
         excluded = set()
 
@@ -485,13 +573,14 @@ class RouterServer(ThreadingHTTPServer):
             if addr is None:
                 raise NoBackends(live=self.pool.live_count(),
                                  total=len(self.pool.addrs()))
-            headers = {"Content-Type": "application/json"}
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
             tp = spans.traceparent()  # None with tracing off
             if tp is not None:
-                headers["traceparent"] = tp
+                hdrs["traceparent"] = tp
             req = urllib.request.Request(
                 f"http://{addr}{path}", data=body, method="POST",
-                headers=headers)
+                headers=hdrs)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.forward_timeout_s) as resp:
@@ -539,6 +628,344 @@ class RouterServer(ThreadingHTTPServer):
         finally:
             self._m_forward.observe(_world.monotonic() - t0)
         return code, data, ctype, retry_after
+
+    # -- hedged /generate -----------------------------------------------
+    def _hedge_delay(self):
+        """Seconds to wait before hedging, or None when hedging is
+        ineligible: the knob disables it, or too few ``route.forward_s``
+        samples exist to trust a tail estimate (an uninformed hedge is
+        just a doubled request)."""
+        q = float(knobs.get("DK_ROUTE_HEDGE_QUANTILE"))
+        if q <= 0:
+            return None
+        s = self._m_forward.summary()
+        if s["count"] < 20:
+            return None
+        q = min(max(q, 0.5), 0.999)
+        return s["p99"] if q >= 0.99 else s["p95"]
+
+    def forward_generate(self, body, headers=None):
+        """Non-streaming ``/generate``: the hedged path when the
+        tail-latency evidence, a live sibling and the retry budget all
+        allow it, else the plain :meth:`forward`."""
+        self._hedge_budget.earn()
+        delay = self._hedge_delay()
+        if delay is None or self.pool.live_count() < 2:
+            return self.forward(body, path="/generate",
+                                headers=headers)
+        try:
+            doc = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            doc = None
+        if not isinstance(doc, dict) or "tokens" not in doc:
+            # bare-list or malformed bodies: the buffered path's
+            # backend answers them typed (200 or 400)
+            return self.forward(body, path="/generate",
+                                headers=headers)
+        return self._hedged_generate(doc, headers, delay)
+
+    def _hedged_generate(self, doc, headers, delay):
+        """Race a primary against (at most) one budget-gated hedge.
+        Both attempts run the BACKEND's streaming surface — the body is
+        rewritten to ``stream: true`` and the NDJSON reassembled into
+        the batched result doc — because a buffered ``/generate`` hop
+        cannot be cancelled: the backend handler sits in
+        ``gen.result()`` until the doc is done whether anyone is
+        listening or not.  On the streaming surface, closing the
+        loser's socket makes its next token write fail, and the
+        backend's own disconnect path cancels the generation (slot and
+        KV pages reclaim).  First complete answer wins."""
+        t0 = _world.monotonic()
+        sdoc = dict(doc)
+        sdoc["stream"] = True
+        sbody = json.dumps(sdoc).encode("utf-8")
+        try:
+            prompt = [int(t) for t in doc.get("tokens", [])]
+        except (TypeError, ValueError):
+            prompt = []
+        resq = _queue.Queue()
+        conns = []
+        conns_lock = threading.Lock()
+        settled = threading.Event()   # a winner exists: losers hush
+
+        def run(addr, hedge):
+            host, _, port = addr.rpartition(":")
+            conn = http.client.HTTPConnection(
+                host, int(port), timeout=self.forward_timeout_s)
+            with conns_lock:
+                conns.append(conn)
+            try:
+                hdrs = {"Content-Type": "application/json"}
+                hdrs.update(headers or {})
+                tp = spans.traceparent()
+                if tp is not None:
+                    hdrs["traceparent"] = tp
+                conn.request("POST", "/generate", sbody, hdrs)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    data = resp.read()
+                    if resp.status == 503:
+                        # backend shedding: a failed attempt, the
+                        # other arm (or the sibling retry) decides
+                        resq.put(("err", ForwardError(addr,
+                                                      "backend 503"),
+                                  addr, hedge))
+                    else:
+                        # a non-503 status IS an answer: verbatim
+                        resq.put(("http", (resp.status, data,
+                                           resp.headers.get(
+                                               "Content-Type",
+                                               "application/json"),
+                                           resp.headers.get(
+                                               "Retry-After")),
+                                  addr, hedge))
+                    return
+                toks = []
+                final = None
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    rec = json.loads(line)
+                    if "token" in rec:
+                        toks.append(int(rec["token"]))
+                    if rec.get("done"):
+                        final = rec
+                        break
+                if final is None:
+                    raise ForwardError(addr, "stream truncated")
+                self.pool.note_forward(addr, ok=True)
+                if "error" in final:
+                    # the backend's typed decode failure: an answer,
+                    # not transport loss — map like _generate's 500
+                    resq.put(("http", (500, json.dumps(
+                        {"error": final["error"],
+                         "detail": final.get("detail", "")}
+                    ).encode("utf-8"), "application/json", None),
+                        addr, hedge))
+                    return
+                out = {"tokens": prompt + toks, "generated": toks,
+                       "prompt_len": final.get("prompt_len"),
+                       "steps": final.get("steps"),
+                       "ttft_s": final.get("ttft_s"),
+                       "finish": final.get("finish"),
+                       "recoveries": final.get("recoveries")}
+                resq.put(("ok", out, addr, hedge))
+            except (OSError, http.client.HTTPException,
+                    ValueError) as e:
+                if settled.is_set():
+                    return   # cancelled loser: not evidence
+                self.pool.note_forward(addr, ok=False)
+                resq.put(("err", e, addr, hedge))
+            finally:
+                conn.close()
+
+        primary = self.pool.pick()
+        if primary is None:
+            _metrics.counter("route.errors").inc()
+            return (503, json.dumps(
+                {"error": "no_backends",
+                 "live": self.pool.live_count(),
+                 "total": len(self.pool.addrs())}).encode("utf-8"),
+                "application/json", 1)
+        attempted = {primary}
+        threading.Thread(target=run, args=(primary, False),
+                         daemon=True).start()
+        inflight = 1
+        got = None
+        try:
+            got = resq.get(timeout=delay)
+        except _queue.Empty:
+            hedge_addr = self.pool.pick(exclude=attempted)
+            if hedge_addr is not None \
+                    and self._hedge_budget.try_spend():
+                _metrics.counter("route.hedges").inc()
+                events.emit("route_hedge", primary=primary,
+                            hedge=hedge_addr,
+                            delay_s=round(delay, 6))
+                attempted.add(hedge_addr)
+                threading.Thread(target=run,
+                                 args=(hedge_addr, True),
+                                 daemon=True).start()
+                inflight = 2
+            elif hedge_addr is not None:
+                _metrics.counter("route.hedge_denied").inc()
+        win = None
+        answer = None
+        last_err = None
+        retried = False
+        deadline = t0 + self.forward_timeout_s
+        while True:
+            if got is not None:
+                kind = got[0]
+                if kind == "ok":
+                    win = got
+                    break
+                if kind == "http":
+                    answer = got
+                    break
+                inflight -= 1
+                last_err = got[1]
+                got = None
+                if inflight == 0:
+                    if not retried:
+                        # the plain path's sibling re-send, preserved:
+                        # a fast connect failure must not end the
+                        # request just because hedging was armed
+                        retried = True
+                        sib = self.pool.pick(exclude=attempted)
+                        if sib is not None:
+                            attempted.add(sib)
+                            threading.Thread(
+                                target=run, args=(sib, False),
+                                daemon=True).start()
+                            inflight = 1
+                            continue
+                    break
+                continue
+            rem = deadline - _world.monotonic()
+            if rem <= 0:
+                break
+            try:
+                got = resq.get(timeout=rem)
+            except _queue.Empty:
+                break
+        settled.set()
+        with conns_lock:
+            for c in conns:
+                # closing a loser's socket IS its cancellation: the
+                # backend's next token write fails and its disconnect
+                # path frees the slot + KV pages
+                c.close()
+        self._m_forward.observe(_world.monotonic() - t0)
+        if win is not None:
+            _, out, addr, was_hedge = win
+            if was_hedge:
+                _metrics.counter("route.hedge_wins").inc()
+            return (200, json.dumps(out).encode("utf-8"),
+                    "application/json", None)
+        if answer is not None:
+            return answer[1]
+        _metrics.counter("route.errors").inc()
+        detail = (str(last_err)[:200] if last_err is not None
+                  else "hedged generate timed out")
+        return (503, json.dumps(
+            {"error": "backends_unavailable",
+             "detail": detail}).encode("utf-8"),
+            "application/json", 1)
+
+    # -- streaming relay ------------------------------------------------
+    def relay_stream(self, handler, body, headers=None):
+        """Relay a ``stream: true`` ``/generate`` chunk-for-chunk.
+        Pre-byte failures (connect, backend 503) move to a sibling
+        with the same evidence accounting as :meth:`forward`; once
+        token bytes have flowed the request is pinned to its backend —
+        a backend dying MID-STREAM ends the response with a final
+        typed NDJSON record (``{"error": "backend_stream_lost",
+        "retryable": true}``) so the client sees a typed, resumable
+        loss instead of a truncated stream.  Replies directly through
+        ``handler`` (chunked)."""
+        t0 = _world.monotonic()
+        excluded = set()
+        resp = None
+        addr = None
+        for _ in range(2):
+            try:
+                fault_point("route.forward")
+            # dklint: ignore[broad-except] an injected route.forward fault burns this attempt; exhaustion is a typed 503
+            except Exception:
+                excluded.add(f"fault-{len(excluded)}")
+                continue
+            addr = self.pool.pick(exclude=excluded)
+            if addr is None:
+                break
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
+            tp = spans.traceparent()
+            if tp is not None:
+                hdrs["traceparent"] = tp
+            req = urllib.request.Request(
+                f"http://{addr}/generate", data=body, method="POST",
+                headers=hdrs)
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.forward_timeout_s)
+                break
+            except urllib.error.HTTPError as e:
+                data = e.read()
+                if e.code == 503:
+                    excluded.add(addr)   # shedding: sibling retry
+                    continue
+                handler._reply_bytes(     # an answer: verbatim
+                    e.code, data,
+                    e.headers.get("Content-Type", "application/json"),
+                    retry_after=e.headers.get("Retry-After"))
+                self._m_forward.observe(_world.monotonic() - t0)
+                return
+            except (OSError, urllib.error.URLError):
+                self.pool.note_forward(addr, ok=False)
+                excluded.add(addr)
+                continue
+        if resp is None:
+            _metrics.counter("route.errors").inc()
+            handler._reply(503, {"error": "backends_unavailable",
+                                 "live": self.pool.live_count(),
+                                 "total": len(self.pool.addrs())},
+                           retry_after=1)
+            self._m_forward.observe(_world.monotonic() - t0)
+            return
+        self.pool.note_forward(addr, ok=True)
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        if handler._trace_header is not None:
+            handler.send_header("traceparent", handler._trace_header)
+        handler.end_headers()
+
+        def chunk(data):
+            handler.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            handler.wfile.flush()
+
+        try:
+            saw_done = False
+            while True:
+                try:
+                    line = resp.readline()
+                    if not line:
+                        # chunked readline() swallows a mid-framing
+                        # close as plain EOF (IncompleteRead is eaten
+                        # by peek) — EOF without a ``done`` record IS
+                        # the truncation signal
+                        err = None if saw_done else "eof"
+                except (OSError, http.client.HTTPException) as e:
+                    err = type(e).__name__
+                    line = b""
+                if not line:
+                    if err is not None:
+                        # the backend died mid-stream: typed final
+                        # record + forward evidence against it — never
+                        # a silently truncated stream
+                        self.pool.note_forward(addr, ok=False)
+                        _metrics.counter("route.stream_errors").inc()
+                        events.emit("route_stream_error", backend=addr,
+                                    error=err)
+                        chunk((json.dumps(
+                            {"done": True,
+                             "error": "backend_stream_lost",
+                             "backend": addr, "retryable": True})
+                            + "\n").encode("utf-8"))
+                    break
+                saw_done = saw_done or b'"done"' in line
+                chunk(line)
+            handler.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            # OUR client went away mid-relay: closing the backend
+            # response (finally) propagates the cancel downstream —
+            # the backend's disconnect path frees the slot + pages
+            pass
+        finally:
+            resp.close()
+            self._m_forward.observe(_world.monotonic() - t0)
 
     # -- health probing -------------------------------------------------
     def probe_once(self):

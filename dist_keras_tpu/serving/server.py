@@ -228,14 +228,19 @@ class _Handler(BaseHTTPRequestHandler):
     # -- decode serving (POST /generate) -------------------------------
     def _handle_generate(self, srv):
         """``POST /generate`` — body ``{"tokens": [...],
-        "max_new_tokens": N, "eos_id": E, "stream": bool}`` (or a bare
-        token list).  Batched replies carry the engine's result doc;
-        ``stream: true`` answers chunked NDJSON, one ``{"token": t}``
-        line per generated token as it lands plus a final ``{"done":
-        true, ...}`` summary line.  Same typed mapping as /predict:
-        Overloaded -> 503 + Retry-After (incl. ``kv_exhausted``),
-        malformed prompt -> 400, deadline -> 504 (the generation is
-        CANCELLED so its slot and KV pages free immediately)."""
+        "max_new_tokens": N, "eos_id": E, "stream": bool,
+        "deadline_s": S, "priority": "interactive"|"batch"}`` (or a
+        bare token list).  Batched replies carry the engine's result
+        doc; ``stream: true`` answers chunked NDJSON, one ``{"token":
+        t}`` line per generated token as it lands plus a final
+        ``{"done": true, ...}`` summary line.  ``deadline_s`` /
+        ``priority`` also arrive as ``x-dk-deadline-s`` /
+        ``x-dk-priority`` headers (the router's propagation channel;
+        the body wins).  Same typed mapping as /predict: Overloaded ->
+        503 + Retry-After (incl. ``kv_exhausted``, ``shed_batch``,
+        ``deadline_infeasible``), malformed prompt -> 400, deadline ->
+        504 (the generation is CANCELLED so its slot and KV pages free
+        immediately)."""
         if not hasattr(srv.engine, "submit_generate"):
             self._reply(501, {
                 "error": "not_implemented",
@@ -251,6 +256,19 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = doc.get("max_new_tokens")
             eos_id = doc.get("eos_id")
             stream = bool(doc.get("stream", False))
+            # end-to-end deadline: the body field wins; the
+            # ``x-dk-deadline-s`` header is the ROUTER's propagation
+            # channel (it forwards the body verbatim, so only a
+            # header survives the hop without a rewrite)
+            deadline_s = doc.get("deadline_s")
+            if deadline_s is None:
+                hdr = self.headers.get("x-dk-deadline-s")
+                deadline_s = float(hdr) if hdr else None
+            elif deadline_s is not None:
+                deadline_s = float(deadline_s)
+            priority = doc.get("priority",
+                               self.headers.get("x-dk-priority",
+                                                "interactive"))
         except (ValueError, KeyError, TypeError) as e:
             self._reply(400, {"error": "bad_request",
                               "detail": str(e)[:200]})
@@ -261,20 +279,24 @@ class _Handler(BaseHTTPRequestHandler):
                             stream=stream):
                 self._trace_header = spans.traceparent()
                 if stream:
-                    self._generate_stream(srv, tokens, max_new, eos_id)
+                    self._generate_stream(srv, tokens, max_new, eos_id,
+                                          deadline_s, priority)
                 else:
                     code, payload, retry = self._generate(
-                        srv, tokens, max_new, eos_id)
+                        srv, tokens, max_new, eos_id, deadline_s,
+                        priority)
                     self._reply(code, payload, retry_after=retry)
 
     def _admit_generate(self, srv, tokens, max_new, eos_id,
-                        on_token=None):
+                        on_token=None, deadline_s=None,
+                        priority="interactive"):
         """-> (generation, None) or (None, (status, payload,
         retry_after)) with the engine's typed failure mapping."""
         try:
             gen = srv.engine.submit_generate(
                 tokens, max_new_tokens=max_new, eos_id=eos_id,
-                on_token=on_token)
+                on_token=on_token, deadline_s=deadline_s,
+                priority=priority)
         except Overloaded as e:
             return None, (503, {"error": "overloaded",
                                 "reason": e.reason,
@@ -289,8 +311,11 @@ class _Handler(BaseHTTPRequestHandler):
                                 "detail": str(e)[:200]}, None)
         return gen, None
 
-    def _generate(self, srv, tokens, max_new, eos_id):
-        gen, err = self._admit_generate(srv, tokens, max_new, eos_id)
+    def _generate(self, srv, tokens, max_new, eos_id,
+                  deadline_s=None, priority="interactive"):
+        gen, err = self._admit_generate(srv, tokens, max_new, eos_id,
+                                        deadline_s=deadline_s,
+                                        priority=priority)
         if err is not None:
             return err
         try:
@@ -307,7 +332,8 @@ class _Handler(BaseHTTPRequestHandler):
                          "detail": str(e)[:200]}, None
         return 200, doc, None
 
-    def _generate_stream(self, srv, tokens, max_new, eos_id):
+    def _generate_stream(self, srv, tokens, max_new, eos_id,
+                         deadline_s=None, priority="interactive"):
         """Chunked-NDJSON streaming: tokens flush as the scheduler
         emits them (the engine's ``on_token`` callback feeds a local
         queue this handler drains)."""
@@ -315,7 +341,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         q = _queue.Queue()
         gen, err = self._admit_generate(srv, tokens, max_new, eos_id,
-                                        on_token=q.put)
+                                        on_token=q.put,
+                                        deadline_s=deadline_s,
+                                        priority=priority)
         if err is not None:
             code, payload, retry = err
             self._reply(code, payload, retry_after=retry)
@@ -354,14 +382,18 @@ class _Handler(BaseHTTPRequestHandler):
                 doc = gen.result(timeout=0)
                 chunk({"done": True, "finish": doc["finish"],
                        "prompt_len": doc["prompt_len"],
-                       "steps": doc["steps"], "ttft_s": doc["ttft_s"]})
+                       "steps": doc["steps"], "ttft_s": doc["ttft_s"],
+                       "recoveries": doc.get("recoveries", 0)})
             # dklint: ignore[broad-except] a failed generation ends the stream with a typed error line
             except Exception as e:
                 chunk({"done": True, "error": type(e).__name__,
                        "detail": str(e)[:200]})
             self.wfile.write(b"0\r\n\r\n")
-        except (ConnectionError, BrokenPipeError):
-            # client went away mid-stream: stop decoding for it
+        except OSError:
+            # client went away mid-stream (reset, broken pipe, or any
+            # other socket-level failure — ConnectionError alone missed
+            # plain OSErrors from a torn-down TLS/proxy hop): stop
+            # decoding for it NOW so its slot and KV pages reclaim
             gen.cancel()
 
 

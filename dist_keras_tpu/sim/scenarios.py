@@ -33,6 +33,12 @@ The scenarios:
   load spike: a backend killed mid-spike is evicted within the stale
   window (connect-failure + heartbeat evidence), re-admitted after
   healing, zero silent drops and zero placements on an evicted host.
+- ``decode_replica_churn`` — sequence-level decode survivability:
+  modeled replicas with real paged-KV allocators under two kill/heal
+  cycles; in-flight sequences on a killed replica re-admit onto
+  survivors via teacher-forced replay — zero lost sequences, every
+  stream bit-identical to the undisturbed oracle, every cache
+  balanced at the end.
 - ``slo_burn`` — the SLO plane's multi-window burn-rate math on sim
   time: a seeded mid-run error window must page inside the fault,
   escalate to the fast class while errors flow, and clear exactly
@@ -982,6 +988,193 @@ def router_decode_spike(world, hosts=None, workdir=None):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def decode_replica_churn(world, hosts=None, workdir=None):
+    """Sequence-level decode survivability under replica churn, on sim
+    time: one modeled :class:`DecodeEngine` with several replicas —
+    each owning a real :class:`~dist_keras_tpu.serving.kv_cache
+    .PagedKVCache` and a fixed slot set — decoding deterministic token
+    streams while two kill/heal cycles churn the replica set.  A
+    killed replica's in-flight sequences are NOT lost: their pages
+    free on the dead cache and each sequence re-admits onto a
+    survivor, teacher-forced-replaying its held prefix (no emission
+    during catch-up) before resuming its stream exactly where it
+    stopped.  Invariants: every placed sequence completes (zero lost
+    — the whole point of recovery), every completed stream is
+    bit-identical to the undisturbed oracle stream, at least one
+    recovery actually happened in each churn cycle, orphans waiting
+    for survivor capacity all drain, and every cache balances to zero
+    pages at the end.  Pure seeded math over real allocators, so two
+    runs with the same seed produce bit-identical stream digests —
+    that equality is the replay row the CI gate enforces."""
+    from dist_keras_tpu.serving.kv_cache import PagedKVCache
+
+    hosts = 3 if hosts is None else max(2, int(hosts))
+    rng = world.rng
+    page_size, num_pages, slots = 4, 24, 6
+    replay_rate = 8          # teacher-forced catch-up positions/tick
+    tick = 0.1
+    churn = [(3.0, 8.0), (10.0, 15.0)]   # (kill, heal) cycles
+    t_end = 18.0
+
+    def tok(sid, i):
+        """The deterministic 'model': next token is a pure function
+        of (sequence, position) — the sim's stand-in for greedy
+        argmax, so replay correctness is exactly stream equality."""
+        return (sid * 31 + i * 7 + 3) % 97
+
+    def fresh_replica(idx):
+        return {"idx": idx, "up": True,
+                "cache": PagedKVCache(num_pages, page_size),
+                "active": {}}   # sid -> seq state dict
+
+    replicas = [fresh_replica(i) for i in range(hosts)]
+    seq_ids = itertools.count()
+    pending = []             # orphans waiting for survivor capacity
+    streams = {}             # sid -> completed token list
+    placed = completed = rejected = recoveries = 0
+    catchup_ticks = 0        # the recovery latency tax, in ticks
+    cycle_recoveries = []
+
+    def place(seq):
+        """Admit onto the most-free live replica — the engine's
+        worst-case page reservation at the door; None if no survivor
+        has room (the orphan waits, it is never dropped)."""
+        live = [r for r in replicas if r["up"]
+                and len(r["active"]) < slots]
+        live.sort(key=lambda r: r["cache"].used_pages())
+        for r in live:
+            need = r["cache"].pages_for(seq["plen"] + seq["max_new"])
+            if num_pages - r["cache"].used_pages() >= need:
+                r["cache"].alloc(seq["sid"],
+                                 seq["plen"] + seq["max_new"])
+                r["active"][seq["sid"]] = seq
+                return r
+        return None
+
+    ki = 0
+    while world.elapsed < t_end:
+        now = world.elapsed
+        if ki < len(churn) and now >= churn[ki][0] \
+                and replicas[ki % hosts]["up"]:
+            # kill: quarantine the replica, free its pages, re-admit
+            # every in-flight sequence onto survivors (teacher-forced
+            # replay of the whole held prefix)
+            victim = replicas[ki % hosts]
+            victim["up"] = False
+            orphans = list(victim["active"].values())
+            victim["active"] = {}
+            victim["cache"] = PagedKVCache(num_pages, page_size)
+            n_rec = 0
+            for seq in orphans:
+                seq["catchup"] = seq["plen"] + len(seq["emitted"])
+                if place(seq) is None:
+                    pending.append(seq)
+                n_rec += 1
+            recoveries += n_rec
+            cycle_recoveries.append(n_rec)
+            world.record("decode_kill", replica=victim["idx"],
+                         orphans=n_rec)
+        if ki < len(churn) and now >= churn[ki][1] \
+                and not replicas[ki % hosts]["up"]:
+            replicas[ki % hosts]["up"] = True
+            world.record("decode_heal", replica=ki % hosts)
+            ki += 1
+        # orphans first (requeue priority), then fresh offered load
+        still = []
+        for seq in pending:
+            if place(seq) is None:
+                still.append(seq)
+        pending[:] = still
+        for _ in range(rng.randrange(0, 3)):
+            sid = next(seq_ids)
+            seq = {"sid": sid, "plen": rng.randrange(2, 9),
+                   "max_new": rng.randrange(5, 21),
+                   "emitted": [], "catchup": 0}
+            if place(seq) is None:
+                rejected += 1     # typed Overloaded at the door
+            else:
+                placed += 1
+        # continuous batching: replaying sequences burn catch-up
+        # positions (emitting nothing), caught-up ones emit one token
+        for r in replicas:
+            if not r["up"]:
+                continue
+            done = []
+            for sid, seq in r["active"].items():
+                if seq["catchup"] > 0:
+                    seq["catchup"] -= min(seq["catchup"], replay_rate)
+                    catchup_ticks += 1
+                    continue
+                seq["emitted"].append(tok(sid, len(seq["emitted"])))
+                if len(seq["emitted"]) >= seq["max_new"]:
+                    done.append(sid)
+            for sid in done:
+                seq = r["active"].pop(sid)
+                r["cache"].free(sid)
+                streams[sid] = seq["emitted"]
+                completed += 1
+        world.advance(tick)
+
+    # drain: no new load; pending orphans re-place as slots free
+    for _ in range(600):
+        if not pending and not any(r["active"] for r in replicas
+                                   if r["up"]):
+            break
+        still = []
+        for seq in pending:
+            if place(seq) is None:
+                still.append(seq)
+        pending[:] = still
+        for r in replicas:
+            if not r["up"]:
+                continue
+            done = []
+            for sid, seq in r["active"].items():
+                if seq["catchup"] > 0:
+                    seq["catchup"] -= min(seq["catchup"], replay_rate)
+                    catchup_ticks += 1
+                    continue
+                seq["emitted"].append(tok(sid, len(seq["emitted"])))
+                if len(seq["emitted"]) >= seq["max_new"]:
+                    done.append(sid)
+            for sid in done:
+                seq = r["active"].pop(sid)
+                r["cache"].free(sid)
+                streams[sid] = seq["emitted"]
+                completed += 1
+        world.advance(tick)
+
+    _require(not pending, f"{len(pending)} orphans never re-placed")
+    _require(completed == placed,
+             f"lost sequences: completed {completed} != placed "
+             f"{placed} — recovery dropped work")
+    _require(recoveries > 0 and len(cycle_recoveries) == len(churn),
+             "no churn cycle actually recovered sequences")
+    _require(all(n > 0 for n in cycle_recoveries),
+             f"a kill caught zero in-flight sequences "
+             f"{cycle_recoveries} — the scenario is not exercising "
+             f"recovery")
+    for sid, emitted in streams.items():
+        oracle = [tok(sid, i) for i in range(len(emitted))]
+        _require(emitted == oracle,
+                 f"seq {sid} stream diverged from the oracle after "
+                 f"recovery")
+    for r in replicas:
+        r["cache"].assert_balanced()
+        _require(r["cache"].used_pages() == 0,
+                 f"replica {r['idx']} leaked "
+                 f"{r['cache'].used_pages()} KV pages")
+    digest = hashlib.sha256(json.dumps(
+        sorted(streams.items()), separators=(",", ":")
+    ).encode()).hexdigest()
+    world.record("decode_digest", sha256=digest[:16])
+    return {"hosts": hosts, "placed": placed, "completed": completed,
+            "rejected": rejected, "recoveries": recoveries,
+            "cycle_recoveries": cycle_recoveries,
+            "catchup_ticks": catchup_ticks,
+            "stream_digest": digest, "sleeps": world.sleeps}
+
+
 def slo_burn(world, hosts=None, workdir=None):
     """The SLO plane's multi-window burn-rate math driven on SIM time:
     seeded modeled serving traffic with a mid-run error window.  The
@@ -1085,5 +1278,6 @@ SCENARIOS = {
     "gc_race": gc_race,
     "router_failover": router_failover,
     "router_decode_spike": router_decode_spike,
+    "decode_replica_churn": decode_replica_churn,
     "slo_burn": slo_burn,
 }

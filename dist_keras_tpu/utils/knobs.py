@@ -315,6 +315,12 @@ _register("DK_DECODE_KERNEL", False, _parse_bool, kind="bool",
               "falls back to the reference with a "
               "`decode_kernel_rejected` event, never silent "
               "corruption")
+_register("DK_DECODE_SHED_WATERMARK", 0.85, float,
+          "KV-page occupancy fraction above which the decode engine "
+          "brownout-sheds `priority=\"batch\"` admissions (typed "
+          "`Overloaded(\"shed_batch\")` -> 503 + Retry-After) so "
+          "interactive traffic keeps its SLO; `batch` is also shed "
+          "while any SLO objective is breaching")
 
 # serving router tier (serving/router.py)
 _register("DK_ROUTE_PORT", None, int, kind="port",
@@ -341,6 +347,17 @@ _register("DK_ROUTE_READMIT_CHECKS", 2, int,
           "consecutive healthy probes a previously-evicted backend "
           "must pass before it re-enters rotation (hysteresis — one "
           "lucky probe never re-admits a flapping host)")
+_register("DK_ROUTE_HEDGE_QUANTILE", 0.95, float,
+          "latency quantile of `route.forward_s` past which a "
+          "non-streaming `/generate` forward is HEDGED to a second "
+          "backend (first answer wins, the loser is cancelled); `0` "
+          "disables hedging, values are clamped to [0.5, 0.999]")
+_register("DK_ROUTE_HEDGE_BUDGET", 0.1, float,
+          "hedge retry budget as a token-bucket ratio: every primary "
+          "forward deposits this many tokens (capped at 10x), each "
+          "hedge spends one — hedges can never amplify an overload "
+          "past this fraction of real traffic; denied hedges count "
+          "`route.hedge_denied`")
 
 # parameter-server training mode
 _register("DK_PS_ADDR", None, str,

@@ -1086,6 +1086,12 @@ finish(resizes=a.resizes, replicas=eng.stats()["replicas"])
 #   continuous generation load across two set_params cutovers — zero
 #   dropped sequences (the old color finishes every sequence it
 #   admitted on its pinned params), old color fully drained.
+# - "survivability": a 2-replica engine loses replica 0 with
+#   sequences in flight — every future still delivers its exact
+#   oracle stream (teacher-forced replay on a survivor), zero errors,
+#   zero leaked pages; plus the deadline door (typed
+#   ``deadline_infeasible``) and brownout shedding (typed
+#   ``shed_batch``, interactive unaffected).
 # - "chaos": targeted decode.admit / decode.kv_alloc / decode.step
 #   faults plus a seeded randomized sweep — every failure typed
 #   (FaultInjected | Overloaded), the engine keeps serving afterwards,
@@ -1211,6 +1217,77 @@ if mode == "bluegreen":
     bg.close()
     finish(**counts, cutovers=bg.cutovers, finishes=finishes)
 
+if mode == "survivability":
+    # sequence-level recovery: an undisturbed reference engine fixes
+    # the oracle streams, then a 2-replica engine loses replica 0 with
+    # sequences in flight — every future must still deliver the exact
+    # oracle stream (teacher-forced replay), zero errors, zero leaks
+    ref = DecodeEngine(Transformer(CFG, seed=0), replicas=1,
+                       prefill_ladder=(8,), decode_ladder=(1, 4),
+                       page_size=4, max_new_default=16,
+                       max_queue=256)
+    expected = [ref.generate(p, max_new_tokens=16,
+                             timeout_s=300)["generated"]
+                for p in prompts[:12]]
+    ref.close()
+    eng = DecodeEngine(Transformer(CFG, seed=0), replicas=2,
+                       prefill_ladder=(8,), decode_ladder=(1, 4),
+                       page_size=4, max_new_default=16,
+                       max_queue=256)
+    eng.generate(prompts[0], max_new_tokens=2, timeout_s=300)  # warm
+    gens = [eng.submit_generate(prompts[i], max_new_tokens=16)
+            for i in range(12)]
+    eng.kill_replica(0)        # crash with sequences in flight
+    docs = []
+    for g in gens:
+        try:
+            docs.append(g.result(timeout=300))
+        except Exception as ex:
+            check(False, "sequence lost to the kill: %r" % (ex,))
+    for i, doc in enumerate(docs):
+        check(doc["generated"] == expected[i],
+              "recovered stream %d diverged from the oracle" % i)
+    st = eng.stats()
+    check(st["quarantines"] == 1, "quarantines=%s" % st["quarantines"])
+    check(st["recovered"] >= 1, "the kill caught nothing in flight")
+    check(st["errors"] == 0, "errors=%s after recovery" % st["errors"])
+    check(st["replicas_dead"] == 1 and st["replicas"] == 1,
+          "replica accounting wrong: %s"
+          % {k: st[k] for k in ("replicas", "replicas_dead")})
+    # the survivor keeps serving, and the deadline door is live
+    doc = eng.generate(prompts[0], max_new_tokens=4, timeout_s=300)
+    check(len(doc["generated"]) == 4, "survivor dead after recovery")
+    try:
+        eng.submit_generate(prompts[1], max_new_tokens=16,
+                            deadline_s=1e-9)
+        check(False, "infeasible deadline admitted")
+    except Overloaded as ex:
+        check(ex.reason == "deadline_infeasible",
+              "wrong rejection: %s" % ex.reason)
+    check(eng.self_check() == 0, "self-check found unowned pages")
+    try:
+        eng.assert_no_leaks()
+    except AssertionError as ex:
+        check(False, "KV pages leaked across recovery: %s" % ex)
+    eng.close()
+    # brownout: a watermark-0 engine sheds batch, keeps interactive
+    shed = DecodeEngine(Transformer(CFG, seed=0), replicas=1,
+                        prefill_ladder=(8,), decode_ladder=(1, 4),
+                        page_size=4, max_new_default=4,
+                        shed_watermark=0.0)
+    try:
+        shed.submit_generate(prompts[0], max_new_tokens=4,
+                             priority="batch")
+        check(False, "brownout admitted batch work")
+    except Overloaded as ex:
+        check(ex.reason == "shed_batch",
+              "wrong shed rejection: %s" % ex.reason)
+    doc = shed.generate(prompts[0], max_new_tokens=2, timeout_s=300)
+    check(len(doc["generated"]) == 2, "brownout shed interactive too")
+    shed.close()
+    finish(recovered=st["recovered"], quarantines=st["quarantines"],
+           deadline_infeasible=1, shed=1)
+
 # mode == "chaos": typed failures only, zero leaked pages
 eng = DecodeEngine(Transformer(CFG), replicas=1, prefill_ladder=(8,),
                    decode_ladder=(1, 4), page_size=4,
@@ -1229,7 +1306,10 @@ with faults.armed("decode.kv_alloc"):
         check(False, "decode.kv_alloc fault did not fire")
     except FaultInjected:
         pass
-with faults.armed("decode.step"):
+# times=2: the engine retries a failed step once in place, so a
+# single-fire fault is absorbed; two fires on the only replica is the
+# typed-surface path
+with faults.armed("decode.step", times=2):
     g = eng.submit_generate(prompts[3], max_new_tokens=8)
     try:
         g.result(timeout=120)
@@ -2880,8 +2960,10 @@ def run_decode_gate(timeout=420):
     _DECODE_WORKER): sustained mixed prefill+decode generation load
     with bounded TTFT p99 and retraces within the prefill+decode
     ladder bound, a mid-decode blue/green reload dropping zero
-    sequences (each finishes on the params it was admitted under), and
-    a seeded decode.* chaos sweep with typed-only failures and zero
+    sequences (each finishes on the params it was admitted under), a
+    replica kill with sequences in flight recovered bit-identically
+    onto a survivor (plus typed deadline/brownout rejections), and a
+    seeded decode.* chaos sweep with typed-only failures and zero
     leaked KV pages."""
     import shutil
     import tempfile
@@ -2901,7 +2983,7 @@ def run_decode_gate(timeout=420):
     detail = {}
     t0 = time.time()
     try:
-        for mode in ("load", "bluegreen", "chaos"):
+        for mode in ("load", "bluegreen", "survivability", "chaos"):
             p = subprocess.Popen([sys.executable, script, mode, work],
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT,
@@ -4077,9 +4159,11 @@ def run_sim_gate(timeout=600):
     races, router failover under a load spike, router failover under a
     spike of long-running decode sequences with paged-KV admission),
     the churn run under its 60s wall budget, and second seeded runs of
-    ``ps_churn``, ``router_failover``, ``router_decode_spike`` AND
-    ``slo_burn`` replaying BIT-IDENTICALLY (trace digest equality
-    across separate processes)."""
+    ``ps_churn``, ``router_failover``, ``router_decode_spike``,
+    ``decode_replica_churn`` AND ``slo_burn`` replaying
+    BIT-IDENTICALLY (trace + stream digest equality across separate
+    processes); ``decode_replica_churn`` must additionally recover
+    in-flight sequences with zero lost."""
     t0 = time.time()
     failures = []
     detail = {}
@@ -4188,6 +4272,39 @@ def run_sim_gate(timeout=600):
                     "router_decode_spike replay diverged: "
                     f"{ds.get('digest', '')[:16]} != "
                     f"{ds2.get('digest', '')[:16]}")
+        dc = next((r for r in doc.get("scenarios", [])
+                   if r.get("scenario") == "decode_replica_churn"),
+                  None)
+        if dc is None or "error" in dc:
+            failures.append(
+                "decode_replica_churn produced no verdict")
+        else:
+            if dc.get("completed") != dc.get("placed"):
+                failures.append(
+                    "decode_replica_churn lost sequences: "
+                    f"completed {dc.get('completed')} != placed "
+                    f"{dc.get('placed')}")
+            if not dc.get("recoveries"):
+                failures.append(
+                    "decode_replica_churn never recovered a "
+                    "sequence")
+            proc6, doc6 = _cli("--scenario", "decode_replica_churn",
+                               "--seed", "0")
+            dc2 = (doc6.get("scenarios") or [{}])[0]
+            detail["survivability_replay"] = {
+                "digest": dc2.get("digest", "")[:16],
+                "stream_digest": dc2.get("stream_digest", "")[:16],
+                "matches": (dc2.get("digest") == dc.get("digest")
+                            and dc2.get("stream_digest")
+                            == dc.get("stream_digest")),
+            }
+            if dc2.get("digest") != dc.get("digest") \
+                    or dc2.get("stream_digest") \
+                    != dc.get("stream_digest"):
+                failures.append(
+                    "decode_replica_churn replay diverged: "
+                    f"{dc.get('digest', '')[:16]} != "
+                    f"{dc2.get('digest', '')[:16]}")
         sb = next((r for r in doc.get("scenarios", [])
                    if r.get("scenario") == "slo_burn"), None)
         if sb is None or "error" in sb:

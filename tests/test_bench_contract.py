@@ -43,12 +43,12 @@ def test_zero_budget_still_yields_complete_record():
     # the loop COMPLETED (every config marked skipped, none lost)
     assert rec["partial"] is False
     # 9 device configs + CPU serving + CPU decode-serving
-    # + CPU router overhead/failover
+    # + CPU decode-survivability + CPU router overhead/failover
     # + CPU ckpt-manifest overhead + CPU ckpt-async-save
     # + CPU diff-ckpt + CPU retrace-proxy attribution
     # + CPU reshard-restore + CPU comm-overlap proxy
     # + CPU ps-compress + CPU sim-swarm + CPU slo-overhead
-    assert len(rec["configs"]) == 21
+    assert len(rec["configs"]) == 22
     assert all(c.get("skipped") == "budget" for c in rec["configs"])
     # driver-contract top-level keys exist even with no headline run
     for key in ("metric", "value", "unit", "vs_baseline"):

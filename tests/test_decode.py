@@ -5,6 +5,8 @@ with zero leaked pages, the HTTP /generate surface, and single-query
 paged-attention kernel parity at every decode-ladder shape."""
 
 import json
+import re
+import socket
 import threading
 import time
 import urllib.error
@@ -22,6 +24,7 @@ from dist_keras_tpu.models.transformer import (
     layer_norm,
     transformer_config,
 )
+from dist_keras_tpu.observability import metrics as _metrics
 from dist_keras_tpu.ops.pallas import decode_attention
 from dist_keras_tpu.resilience import faults
 from dist_keras_tpu.resilience.faults import FaultInjected
@@ -31,6 +34,7 @@ from dist_keras_tpu.serving import (
     Overloaded,
     PagedKVCache,
     PagesExhausted,
+    RouterServer,
     ServingServer,
 )
 
@@ -316,13 +320,28 @@ def test_fault_points_typed(engine_and_model):
     with faults.armed("decode.kv_alloc"):
         with pytest.raises(FaultInjected):
             eng.submit_generate([1, 2], max_new_tokens=4)
-    with faults.armed("decode.step"):
+    # a single step fault is absorbed by the in-place retry (the
+    # survivability retry policy); past the retry, a single-replica
+    # engine has no survivor to quarantine onto, so it lands TYPED
+    with faults.armed("decode.step", times=2):
         g = eng.submit_generate([1, 2], max_new_tokens=6)
         with pytest.raises(FaultInjected):
             g.result(timeout=300)
     # the engine keeps serving after every fault
     doc = eng.generate([1, 2], max_new_tokens=2, timeout_s=300)
     assert len(doc["generated"]) == 2
+    eng.assert_no_leaks()
+
+
+def test_step_fault_absorbed_by_retry(engine_and_model):
+    # one transient step failure: the dispatch retries in place and
+    # the caller never notices (pools and kv_len advance only on
+    # success, so the retry is sound)
+    eng, m = engine_and_model
+    with faults.armed("decode.step", times=1):
+        doc = eng.generate([2, 4, 6], max_new_tokens=4, timeout_s=300)
+    assert doc["generated"] == _oracle_generate(m.params, m.cfg,
+                                                [2, 4, 6], 4)
     eng.assert_no_leaks()
 
 
@@ -354,6 +373,299 @@ def test_seeded_chaos_sweep_zero_leaks():
         eng.assert_no_leaks()
     finally:
         eng.close(drain=False)
+
+
+# -- survivability: quarantine + sequence-level recovery ---------------
+def _owner_index(eng, gen):
+    """Replica currently holding a generation (whitebox: the engine
+    deliberately does not expose placement)."""
+    with eng._cond:
+        for rep in eng._replicas:
+            if gen._seq in rep.active or gen._seq in rep.queue:
+                return rep.index
+    return None
+
+
+def test_kill_replica_racing_prefill_bit_identical():
+    # the kill lands while the sequence is queued or mid-prefill (the
+    # first jit compile is slow); either way the survivor replays it
+    # and the future never sees the failure
+    m = _model()
+    eng = _engine(m, replicas=2, num_pages=32)
+    try:
+        prompt = [3, 1, 4, 1]
+        seen = []
+        g = eng.submit_generate(prompt, max_new_tokens=6,
+                                on_token=seen.append)
+        eng.kill_replica(0)      # first admission lands on replica 0
+        doc = g.result(timeout=300)
+        want = _oracle_generate(m.params, m.cfg, prompt, 6)
+        assert doc["generated"] == want
+        assert seen == want      # streaming resumed: no dup, no skip
+        st = eng.stats()
+        assert st["quarantines"] == 1
+        assert st["replicas_dead"] == 1
+        assert st["replicas"] == 1
+        eng.assert_no_leaks()
+        assert eng.self_check() == 0
+    finally:
+        eng.close(drain=True)
+
+
+def test_kill_replica_mid_decode_bit_identical():
+    # the kill fires from the token stream itself after two tokens —
+    # squarely between decode steps on the owning replica; the replay
+    # is teacher-forced so the stream resumes exactly where it stopped
+    m = _model()
+    eng = _engine(m, replicas=2, num_pages=32)
+    try:
+        prompt = [2, 7, 1]
+        seen = []
+
+        def on_token(t):
+            seen.append(t)
+            if len(seen) == 2:
+                eng.kill_replica(0)
+
+        g = eng.submit_generate(prompt, max_new_tokens=6,
+                                on_token=on_token)
+        doc = g.result(timeout=300)
+        want = _oracle_generate(m.params, m.cfg, prompt, 6)
+        assert doc["generated"] == want
+        assert seen == want
+        assert doc["recoveries"] == 1
+        assert doc["finish"] == "length"
+        st = eng.stats()
+        assert st["quarantines"] == 1
+        assert st["recovered"] == 1
+        eng.assert_no_leaks()
+    finally:
+        eng.close(drain=True)
+
+
+def test_step_fault_past_retry_quarantines_and_recovers():
+    # decode.step fails twice (beats the 1 in-place retry) on the
+    # owning replica; a survivor exists, so the replica quarantines
+    # and the sequence replays to a bit-identical doc — the caller
+    # never sees FaultInjected
+    m = _model()
+    eng = _engine(m, replicas=2, num_pages=32)
+    try:
+        prompt = [5, 3]
+        with faults.armed("decode.step", times=2):
+            doc = eng.generate(prompt, max_new_tokens=5, timeout_s=300)
+        assert doc["generated"] == _oracle_generate(m.params, m.cfg,
+                                                    prompt, 5)
+        assert doc["recoveries"] == 1
+        st = eng.stats()
+        assert st["quarantines"] == 1
+        assert st["recovered"] == 1
+        assert st["errors"] == 0
+        eng.assert_no_leaks()
+    finally:
+        eng.close(drain=True)
+
+
+def test_recover_fault_fails_orphans_typed():
+    # recovery itself is the injected failure: orphans resolve typed
+    # (never hung), pages reclaimed
+    eng = _engine(replicas=2, num_pages=32)
+    try:
+        g = eng.submit_generate([1, 2], max_new_tokens=6)
+        with faults.armed("decode.recover"):
+            eng.kill_replica(0)
+            with pytest.raises(FaultInjected):
+                g.result(timeout=300)
+        eng.assert_no_leaks()
+        assert eng.stats()["errors"] == 1
+    finally:
+        eng.close(drain=True)
+
+
+def test_kill_last_live_replica_refused():
+    eng = _engine(replicas=2, num_pages=32)
+    try:
+        eng.kill_replica(1)
+        deadline = time.monotonic() + 60
+        while (eng.stats()["replicas_dead"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        with pytest.raises(ValueError):
+            eng.kill_replica(0)   # whole-pod loss is out of scope
+        with pytest.raises(ValueError):
+            eng.kill_replica(1)   # already dead
+        doc = eng.generate([1, 2], max_new_tokens=2, timeout_s=300)
+        assert len(doc["generated"]) == 2
+    finally:
+        eng.close(drain=True)
+
+
+def test_churn_many_sequences_zero_lost():
+    # several in-flight sequences, one replica killed mid-load: every
+    # future resolves to the oracle answer, nothing lost, no leaks
+    m = _model()
+    eng = _engine(m, replicas=3, num_pages=48, max_queue=64)
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, VOCAB, size=int(n)).tolist()
+                   for n in rng.integers(2, 6, size=6)]
+        gens = [eng.submit_generate(p, max_new_tokens=5)
+                for p in prompts]
+        eng.kill_replica(0)
+        for p, g in zip(prompts, gens):
+            doc = g.result(timeout=300)
+            assert doc["generated"] == _oracle_generate(
+                m.params, m.cfg, p, 5)
+        st = eng.stats()
+        assert st["quarantines"] == 1
+        assert st["completed"] == 6
+        assert st["errors"] == 0
+        eng.assert_no_leaks()
+        assert eng.self_check() == 0
+    finally:
+        eng.close(drain=True)
+
+
+def test_kill_with_full_survivor_orphans_wait_not_fail():
+    # the survivor's pool cannot hold the orphans at quarantine time:
+    # they WAIT for capacity (they were admitted once — the door
+    # contract is spent) and complete bit-identically as pages free,
+    # instead of resolving Overloaded("replica_lost")
+    m = _model()
+    # 8 pages/replica; each sequence reserves 4 (2 prompt + 14 new =
+    # 16 tokens): two sequences fill a replica exactly
+    eng = _engine(m, replicas=2, num_pages=8, max_queue=64)
+    try:
+        prompts = [[1, 2], [3, 4], [5, 6], [7, 8]]
+        gens = [eng.submit_generate(p, max_new_tokens=14)
+                for p in prompts]
+        eng.kill_replica(0)
+        for p, g in zip(prompts, gens):
+            doc = g.result(timeout=300)
+            assert doc["generated"] == _oracle_generate(
+                m.params, m.cfg, p, 14)
+        st = eng.stats()
+        assert st["quarantines"] == 1
+        assert st["recovered"] == 2
+        assert st["completed"] == 4
+        assert st["errors"] == 0
+        assert st["orphans_pending"] == 0
+        eng.assert_no_leaks()
+        assert eng.self_check() == 0
+    finally:
+        eng.close(drain=True)
+
+
+# -- deadlines + shedding ----------------------------------------------
+def test_deadline_infeasible_rejected_at_door(engine_and_model):
+    eng, _ = engine_and_model
+    # warm the prefill/step EWMAs so feasibility has an estimate
+    eng.generate([1, 2], max_new_tokens=2, timeout_s=300)
+    with pytest.raises(Overloaded) as ei:
+        eng.submit_generate([1, 2], max_new_tokens=8,
+                            deadline_s=1e-6)
+    assert ei.value.reason == "deadline_infeasible"
+    assert eng.stats()["deadline_infeasible"] >= 1
+    with pytest.raises(ValueError):
+        eng.submit_generate([1, 2], deadline_s=0)
+
+
+def test_deadline_expiry_frees_slot_mid_decode():
+    # fresh engine: no EWMAs yet, so the door admits; the token
+    # callback stalls past the deadline and the scheduler retires the
+    # sequence between steps with the tokens produced so far
+    eng = _engine(num_pages=32)
+    try:
+        def stall(_t):
+            time.sleep(0.4)
+
+        g = eng.submit_generate([1, 2], max_new_tokens=8,
+                                deadline_s=0.2, on_token=stall)
+        doc = g.result(timeout=300)
+        assert doc["finish"] == "deadline"
+        assert 1 <= len(doc["generated"]) < 8
+        st = eng.stats()
+        assert st["deadline_expired"] == 1
+        assert st["completed"] == 0
+        eng.assert_no_leaks()
+    finally:
+        eng.close(drain=True)
+
+
+def test_brownout_sheds_batch_keeps_interactive():
+    # watermark 0: every batch admission sheds, interactive sails
+    # through — and sheds land on their own meter, not rejected
+    eng = _engine(num_pages=32, shed_watermark=0.0)
+    try:
+        with pytest.raises(Overloaded) as ei:
+            eng.submit_generate([1, 2], max_new_tokens=2,
+                                priority="batch")
+        assert ei.value.reason == "shed_batch"
+        doc = eng.generate([1, 2], max_new_tokens=2, timeout_s=300)
+        assert len(doc["generated"]) == 2
+        st = eng.stats()
+        assert st["shed"] == 1
+        assert st["rejected"] == 0
+        with pytest.raises(ValueError):
+            eng.submit_generate([1, 2], priority="bulk")
+    finally:
+        eng.close(drain=True)
+
+
+def test_batch_admits_below_watermark(engine_and_model):
+    eng, m = engine_and_model
+    doc = eng.generate([4, 2], max_new_tokens=3, timeout_s=300)
+    g = eng.submit_generate([4, 2], max_new_tokens=3,
+                            priority="batch")
+    assert g.result(timeout=300)["generated"] == doc["generated"]
+
+
+# -- KV-leak regression: races + the periodic self-check ---------------
+def test_cancel_after_completion_returns_false(engine_and_model):
+    eng, _ = engine_and_model
+    g = eng.submit_generate([1, 2], max_new_tokens=2)
+    g.result(timeout=300)
+    assert g.cancel() is False    # finished: nothing left to cancel
+    eng.assert_no_leaks()
+
+
+def test_cancel_race_with_sequence_done_never_leaks():
+    # hammer the cancel/completion race: whichever side wins, pages
+    # reclaim exactly once and the future resolves exactly once
+    eng = _engine(num_pages=32)
+    try:
+        for _ in range(8):
+            g = eng.submit_generate([1, 2], max_new_tokens=1)
+            g.cancel()
+            doc_or_err = None
+            try:
+                doc_or_err = g.result(timeout=300)
+            except Overloaded:
+                pass
+            if doc_or_err is not None:
+                assert doc_or_err["finish"] in ("cancelled", "length",
+                                                "eos")
+        deadline = time.monotonic() + 60
+        while (eng.stats()["outstanding"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        eng.assert_no_leaks()
+        assert eng.self_check() == 0
+    finally:
+        eng.close(drain=True)
+
+
+def test_self_check_reclaims_and_counts_unowned_pages():
+    eng = _engine(num_pages=32)
+    try:
+        eng._replicas[0].cache.alloc("ghost", 4)   # a planted leak
+        freed = eng.self_check()
+        assert freed == 1                          # one 4-token page
+        assert eng.stats()["kv_leaked"] == 1
+        eng.assert_no_leaks()
+        assert eng.self_check() == 0               # idempotent
+    finally:
+        eng.close(drain=True)
 
 
 # -- HTTP surface ------------------------------------------------------
@@ -489,3 +801,316 @@ def test_stats_shape_and_ttft(engine_and_model):
     assert st["retrace_count"] <= st["retrace_bound"]
     assert st["ttft_s"]["count"] >= 1
     assert st["kv"]["used_pages"] == 0
+
+
+# -- HTTP deadline/priority + disconnect reclaim -----------------------
+def test_generate_endpoint_deadline_body_and_priority(served_decode):
+    eng, m, url = served_decode
+    eng.generate([1, 2], max_new_tokens=2, timeout_s=300)  # warm EWMAs
+    code, doc = _post(url + "/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 8,
+                       "deadline_s": 1e-9})
+    assert code == 503
+    assert doc["reason"] == "deadline_infeasible"
+    code, doc = _post(url + "/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 2,
+                       "deadline_s": 300.0, "priority": "batch"})
+    assert code == 200 and len(doc["generated"]) == 2
+    code, doc = _post(url + "/generate",
+                      {"tokens": [1, 2], "priority": "bogus"})
+    assert code == 400
+
+
+def test_client_disconnect_mid_stream_reclaims_pages(served_decode):
+    # the client reads ONE token line and slams the socket shut: the
+    # server's next chunk write fails and the generation cancels, so
+    # the slot and its KV pages reclaim instead of decoding to nobody
+    eng, m, url = served_decode
+    host, port = url.replace("http://", "").split(":")
+    body = json.dumps({"tokens": [3, 1], "max_new_tokens": 30,
+                       "stream": True}).encode()
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall(b"POST /generate HTTP/1.1\r\n"
+              b"Host: x\r\nContent-Type: application/json\r\n"
+              + b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    buf = b""
+    while b'"token"' not in buf:
+        buf += s.recv(4096)
+    s.close()                           # mid-stream disconnect
+    deadline = time.monotonic() + 60
+    while eng.stats()["outstanding"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    st = eng.stats()
+    assert st["outstanding"] == 0
+    assert st["cancelled"] >= 1
+    eng.assert_no_leaks()
+    assert eng.self_check() == 0
+
+
+# -- router: deadline propagation, stream relay, hedging ---------------
+class _StallBackend:
+    """Accepts and reads the request, then never answers — the router-
+    visible signature of a wedged host (the hedge's raison d'etre)."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = "127.0.0.1:%d" % self.sock.getsockname()[1]
+        self.hits = 0
+        self._conns = []
+        self._stop = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            self._conns.append(conn)   # held open, never answered
+
+    def close(self):
+        self._stop = True
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _DyingStreamBackend:
+    """Answers /generate with a 200 chunked NDJSON stream, emits two
+    token lines, then dies abruptly — a backend crash mid-stream."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = "127.0.0.1:%d" % self.sock.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                self._serve(conn)
+            except OSError:
+                pass
+
+    def _serve(self, conn):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            got = conn.recv(65536)
+            if not got:
+                return
+            data += got
+        head, _, rest = data.partition(b"\r\n\r\n")
+        if head.startswith(b"GET"):    # health probe: stay in rotation
+            body = b'{"ok": true}'
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n"
+                         + b"Content-Length: %d\r\n\r\n" % len(body)
+                         + body)
+            conn.close()
+            return
+        m = re.search(rb"content-length:\s*(\d+)", head, re.I)
+        need = int(m.group(1)) if m else 0
+        while len(rest) < need:
+            rest += conn.recv(65536)
+        out = (b"HTTP/1.1 200 OK\r\n"
+               b"Content-Type: application/x-ndjson\r\n"
+               b"Transfer-Encoding: chunked\r\n\r\n")
+        for ln in (b'{"i": 0, "token": 1}\n', b'{"i": 1, "token": 2}\n'):
+            out += b"%x\r\n" % len(ln) + ln + b"\r\n"
+        conn.sendall(out)
+        time.sleep(0.05)
+        conn.close()                   # no terminating chunk: death
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def routed_decode():
+    m = _model()
+    eng = _engine(m, num_pages=64, max_queue=256)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    router = RouterServer([f"{host}:{port}"], port=0, probe_s=30.0,
+                          forward_timeout_s=60.0)
+    rhost, rport = router.start()
+    yield eng, m, f"http://{rhost}:{rport}", router
+    router.close()
+    srv.close()
+
+
+def test_router_relays_generate_stream(routed_decode):
+    eng, m, url, _router = routed_decode
+    req = urllib.request.Request(
+        url + "/generate",
+        data=json.dumps({"tokens": [3, 1, 4], "max_new_tokens": 5,
+                         "stream": True}).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        lines = [json.loads(ln) for ln in r.read().splitlines() if ln]
+    toks = [ln["token"] for ln in lines if "token" in ln]
+    assert toks == _oracle_generate(m.params, m.cfg, [3, 1, 4], 5)
+    assert lines[-1]["done"] is True
+    assert lines[-1]["finish"] == "length"
+
+
+def test_router_deadline_header_reaches_admission(routed_decode):
+    eng, m, url, _router = routed_decode
+    eng.generate([1, 2], max_new_tokens=2, timeout_s=300)  # warm EWMAs
+    req = urllib.request.Request(
+        url + "/generate",
+        data=json.dumps({"tokens": [1, 2],
+                         "max_new_tokens": 8}).encode("utf-8"),
+        headers={"Content-Type": "application/json",
+                 "x-dk-deadline-s": "1e-9"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=60)
+    assert ei.value.code == 503
+    ei.value.read()
+    # the header crossed the hop: the BACKEND's admission counted it
+    assert eng.stats()["deadline_infeasible"] >= 1
+
+
+def test_router_priority_header_sheds_batch():
+    m = _model()
+    eng = _engine(m, num_pages=64, shed_watermark=0.0)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    router = RouterServer([f"{host}:{port}"], port=0, probe_s=30.0,
+                          forward_timeout_s=60.0)
+    rhost, rport = router.start()
+    try:
+        req = urllib.request.Request(
+            f"http://{rhost}:{rport}/generate",
+            data=json.dumps({"tokens": [1, 2],
+                             "max_new_tokens": 2}).encode("utf-8"),
+            headers={"Content-Type": "application/json",
+                     "x-dk-priority": "batch"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 503
+        ei.value.read()
+        assert eng.stats()["shed"] >= 1       # shed at the backend door
+        assert eng.stats()["rejected"] == 0   # on its own meter
+    finally:
+        router.close()
+        srv.close()
+
+
+def test_router_stream_backend_death_typed_final_record():
+    dying = _DyingStreamBackend()
+    router = RouterServer([dying.addr], port=0, probe_s=30.0,
+                          forward_timeout_s=30.0)
+    rhost, rport = router.start()
+    c_err = _metrics.counter("route.stream_errors")
+    v0 = c_err.value
+    try:
+        req = urllib.request.Request(
+            f"http://{rhost}:{rport}/generate",
+            data=json.dumps({"tokens": [1, 2], "stream": True}
+                            ).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            lines = [json.loads(ln) for ln in r.read().splitlines()
+                     if ln]
+        # the relayed tokens arrived, then the TYPED loss record —
+        # never a silently truncated stream
+        assert [ln["token"] for ln in lines if "token" in ln] == [1, 2]
+        assert lines[-1]["error"] == "backend_stream_lost"
+        assert lines[-1]["retryable"] is True
+        assert c_err.value == v0 + 1
+    finally:
+        router.close()
+        dying.close()
+
+
+def test_router_hedged_generate_first_wins():
+    # primary wedges; past the observed latency tail the router hedges
+    # onto the sibling, whose answer wins — reassembled into the same
+    # batched doc a direct /generate returns
+    m = _model()
+    eng = _engine(m, num_pages=64)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    stall = _StallBackend()
+    router = RouterServer([stall.addr, f"{host}:{port}"], port=0,
+                          probe_s=30.0, forward_timeout_s=60.0)
+    for _ in range(400):   # feed the tail estimate (>= 20 samples)
+        router._m_forward.observe(0.005)
+    real_pick = router.pool.pick
+    router.pool.pick = (lambda exclude=():
+                        stall.addr if not exclude
+                        else real_pick(exclude=exclude))
+    c_hedge = _metrics.counter("route.hedges")
+    c_wins = _metrics.counter("route.hedge_wins")
+    h0, w0 = c_hedge.value, c_wins.value
+    try:
+        body = json.dumps({"tokens": [3, 1, 4],
+                           "max_new_tokens": 5}).encode("utf-8")
+        code, payload, ctype, _retry = router.forward_generate(body)
+        assert code == 200
+        doc = json.loads(payload.decode("utf-8"))
+        assert doc["generated"] == _oracle_generate(m.params, m.cfg,
+                                                    [3, 1, 4], 5)
+        assert doc["tokens"] == [3, 1, 4] + doc["generated"]
+        assert doc["finish"] == "length"
+        assert c_hedge.value == h0 + 1
+        assert c_wins.value == w0 + 1
+        assert stall.hits == 1           # the loser was tried once...
+        eng.assert_no_leaks()            # ...and the winner cleaned up
+    finally:
+        router.close()
+        srv.close()
+        stall.close()
+
+
+def test_router_hedge_denied_without_budget():
+    m = _model()
+    eng = _engine(m, num_pages=64)
+    srv = ServingServer(eng, port=0)
+    host, port = srv.start()
+    stall = _StallBackend()
+    router = RouterServer([stall.addr, f"{host}:{port}"], port=0,
+                          probe_s=30.0, forward_timeout_s=3.0)
+    for _ in range(400):
+        router._m_forward.observe(0.005)
+    real_pick = router.pool.pick
+    router.pool.pick = (lambda exclude=():
+                        stall.addr if not exclude
+                        else real_pick(exclude=exclude))
+    router._hedge_budget.ratio = 0.0     # budget drained for good
+    router._hedge_budget._tokens = 0.0
+    c_denied = _metrics.counter("route.hedge_denied")
+    d0 = c_denied.value
+    try:
+        body = json.dumps({"tokens": [1, 2],
+                           "max_new_tokens": 2}).encode("utf-8")
+        code, payload, _ctype, retry = router.forward_generate(body)
+        # no budget -> no duplicate: the wedged primary times out into
+        # a typed 503 (the caller's whole-request retry is the bound)
+        assert code == 503
+        assert retry is not None
+        assert c_denied.value == d0 + 1
+    finally:
+        router.close()
+        srv.close()
+        stall.close()

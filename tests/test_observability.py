@@ -255,6 +255,39 @@ def test_summarize_attributes_preemption_and_phases(tmp_path):
     assert "agreed save step: 7" in rendered
 
 
+def test_summarize_attributes_decode_recovery(tmp_path):
+    w = events.EventWriter(tmp_path, rank=0)
+    w.emit("decode_quarantine", replica=0, orphans=3,
+           cause="Overloaded")
+    w.emit("decode_recover", sid=1, src=0, dst=1, generated=2,
+           recoveries=1)
+    w.emit("decode_recover", sid=2, src=0, dst=1, generated=0,
+           recoveries=1)
+    w.emit("decode_recover", sid=3, src=None, dst=2, generated=4,
+           recoveries=1)
+    w.emit("decode_shed", reason="kv_watermark", prompt_len=4)
+    w.emit("decode_deadline", phase="admission", deadline_s=0.1,
+           estimate_s=0.4)
+    w.emit("decode_deadline", sid=9, phase="expiry", generated=2)
+    w.emit("decode_kv_leak", replica=1, sid=99, pages=2)
+    w.close()
+    s = report.summarize(report.read_events(tmp_path))
+    dc = s["decode"]
+    assert dc["quarantines"] == [{"replica": 0, "orphans": 3,
+                                  "cause": "Overloaded"}]
+    assert dc["recoveries_by_replica"] == {1: 2, 2: 1}
+    assert dc["sheds_by_reason"] == {"kv_watermark": 1}
+    assert dc["deadline"] == {"infeasible": 1, "expired": 1}
+    assert dc["kv_pages_reclaimed"] == 2
+    rendered = report.render(tmp_path)
+    assert "decode survivability:" in rendered
+    assert "replica 0 quarantined (Overloaded)" in rendered
+    assert "3 recovered onto" in rendered
+    assert "kv_watermark x1" in rendered
+    assert "1 rejected at the door, 1 expired mid-decode" in rendered
+    assert "self-check reclaimed 2 page(s)" in rendered
+
+
 def test_report_cli_json_and_exit_codes(tmp_path, capsys):
     from dist_keras_tpu.observability.__main__ import main
 
